@@ -1,0 +1,50 @@
+"""handel-tpu: TPU-native Byzantine multi-signature aggregation framework.
+
+A from-scratch rebuild of the capabilities of the Handel reference implementation
+(isabella232/handel, Go): the binomial-tree aggregation protocol, pluggable
+BLS signature schemes, pluggable transports, fault injection, and a full
+simulation/benchmark harness — with the signature verification hot loop
+(BN254/BLS12-381 pairings) implemented as batched JAX kernels for TPU.
+
+Layer map (mirrors reference SURVEY.md §1, redesigned TPU-first):
+
+  L5  sim/        simulation & benchmark harness (platforms, sync, monitor)
+  L4  baselines/  gossip comparison protocols
+  L3  core/       aggregation runtime (state machine, store, processing)
+  L2a models/     signature schemes (bn254 python/c++/jax, bls12-381, fake)
+      ops/        JAX field/curve/pairing kernels (the TPU compute path)
+      parallel/   device mesh, sharded multi-pairing, batch verifier service
+  L2b network/    wire encodings + UDP/TCP transports
+  L1  core interfaces (crypto.py, net.py, bitset.py, identity.py)
+"""
+
+__version__ = "0.1.0"
+
+from handel_tpu.core.bitset import BitSet
+from handel_tpu.core.crypto import (
+    Constructor,
+    MultiSignature,
+    PublicKey,
+    SecretKey,
+    Signature,
+    verify_multisignature,
+)
+from handel_tpu.core.identity import Identity, Registry, ArrayRegistry
+from handel_tpu.core.config import Config, default_config
+from handel_tpu.core.handel import Handel
+
+__all__ = [
+    "BitSet",
+    "Constructor",
+    "MultiSignature",
+    "PublicKey",
+    "SecretKey",
+    "Signature",
+    "verify_multisignature",
+    "Identity",
+    "Registry",
+    "ArrayRegistry",
+    "Config",
+    "default_config",
+    "Handel",
+]

@@ -1,0 +1,1 @@
+"""Core aggregation runtime: interfaces + Handel state machine (reference L1+L3)."""

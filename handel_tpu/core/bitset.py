@@ -1,0 +1,174 @@
+"""Fixed-length bitset with the reference wire format and a device-friendly view.
+
+Reference: bitset.go:12-207 — the `BitSet` interface (Set/Get/Cardinality/Len/
+Or/And/Xor/IsSuperSet/NextSet/IntersectionCardinality/All/None/Any/Clone) and the
+WilffBitSet implementation with its uint16-length-prefixed wire format
+(bitset.go:150-177).
+
+TPU-first design: the backing store is a little-endian array of uint64 words
+(NumPy), so `mask_bool()` can hand the same bits to device kernels as dense
+masks for batched pairing / segment-sum work without a per-bit Python loop
+(SURVEY.md §2.1 "packed uint32[] device representation used as pairing-batch
+masks").
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+
+class BitSet:
+    """Fixed-length mutable bitset.
+
+    Unlike the reference's interface/impl split (bitset.go:12-54 vs 56-148) there
+    is a single concrete class; it is cheap, NumPy-backed, and already in the
+    layout device code wants.
+    """
+
+    __slots__ = ("_n", "_words")
+
+    def __init__(self, length: int, _words: np.ndarray | None = None):
+        if length < 0:
+            raise ValueError("bitset length must be >= 0")
+        self._n = length
+        nwords = (length + 63) // 64
+        if _words is not None:
+            assert _words.shape == (nwords,) and _words.dtype == np.uint64
+            self._words = _words
+        else:
+            self._words = np.zeros(nwords, dtype=np.uint64)
+
+    # -- basic ops ---------------------------------------------------------
+
+    def __len__(self) -> int:
+        return self._n
+
+    def set(self, idx: int, value: bool = True) -> None:
+        if not 0 <= idx < self._n:
+            raise IndexError(f"bit {idx} out of range [0,{self._n})")
+        w, b = divmod(idx, 64)
+        if value:
+            self._words[w] |= np.uint64(1 << b)
+        else:
+            self._words[w] &= np.uint64(~(1 << b) & 0xFFFFFFFFFFFFFFFF)
+
+    def get(self, idx: int) -> bool:
+        if not 0 <= idx < self._n:
+            raise IndexError(f"bit {idx} out of range [0,{self._n})")
+        w, b = divmod(idx, 64)
+        return bool((int(self._words[w]) >> b) & 1)
+
+    def cardinality(self) -> int:
+        return int(np.bitwise_count(self._words).sum())
+
+    def clone(self) -> "BitSet":
+        return BitSet(self._n, self._words.copy())
+
+    # -- set algebra (reference bitset.go:93-148) --------------------------
+
+    def _check_same(self, other: "BitSet") -> None:
+        if self._n != len(other):
+            raise ValueError(f"bitset length mismatch: {self._n} vs {len(other)}")
+
+    def or_(self, other: "BitSet") -> "BitSet":
+        self._check_same(other)
+        return BitSet(self._n, np.bitwise_or(self._words, other._words))
+
+    def and_(self, other: "BitSet") -> "BitSet":
+        self._check_same(other)
+        return BitSet(self._n, np.bitwise_and(self._words, other._words))
+
+    def xor(self, other: "BitSet") -> "BitSet":
+        self._check_same(other)
+        return BitSet(self._n, np.bitwise_xor(self._words, other._words))
+
+    def is_superset(self, other: "BitSet") -> bool:
+        self._check_same(other)
+        return bool(
+            np.all(np.bitwise_and(self._words, other._words) == other._words)
+        )
+
+    def intersection_cardinality(self, other: "BitSet") -> int:
+        self._check_same(other)
+        return int(
+            np.bitwise_count(np.bitwise_and(self._words, other._words)).sum()
+        )
+
+    def all(self) -> bool:
+        return self.cardinality() == self._n
+
+    def none(self) -> bool:
+        return not self._words.any()
+
+    def any(self) -> bool:
+        return bool(self._words.any())
+
+    def next_set(self, start: int = 0) -> int | None:
+        """Index of the first set bit >= start, or None (bitset.go:131-139)."""
+        for i in range(start, self._n):
+            if self.get(i):
+                return i
+        return None
+
+    def indices(self) -> list[int]:
+        """All set-bit indices, ascending."""
+        if self._n == 0:
+            return []
+        bits = np.unpackbits(
+            self._words.view(np.uint8), bitorder="little"
+        )[: self._n]
+        return np.nonzero(bits)[0].tolist()
+
+    # -- device views ------------------------------------------------------
+
+    def mask_bool(self, length: int | None = None) -> np.ndarray:
+        """Dense bool mask (optionally zero-padded to `length`) for device kernels."""
+        n = self._n if length is None else length
+        bits = np.unpackbits(self._words.view(np.uint8), bitorder="little")
+        out = np.zeros(n, dtype=bool)
+        m = min(self._n, n)
+        out[:m] = bits[:m]
+        return out
+
+    # -- wire format (reference bitset.go:150-177) -------------------------
+
+    def marshal(self) -> bytes:
+        """uint16 big-endian bit-length || minimal little-endian-bit bytes."""
+        if self._n > 0xFFFF:
+            raise ValueError("bitset too large for wire format")
+        nbytes = (self._n + 7) // 8
+        payload = self._words.view(np.uint8).tobytes()[:nbytes]
+        return struct.pack(">H", self._n) + payload
+
+    @classmethod
+    def unmarshal(cls, data: bytes) -> tuple["BitSet", int]:
+        """Parse a marshaled bitset; returns (bitset, bytes consumed)."""
+        if len(data) < 2:
+            raise ValueError("bitset wire data too short")
+        (n,) = struct.unpack(">H", data[:2])
+        nbytes = (n + 7) // 8
+        if len(data) < 2 + nbytes:
+            raise ValueError("bitset wire data truncated")
+        bs = cls(n)
+        raw = np.frombuffer(data[2 : 2 + nbytes], dtype=np.uint8)
+        padded = np.zeros(((n + 63) // 64) * 8, dtype=np.uint8)
+        padded[: len(raw)] = raw
+        bs._words = padded.view(np.uint64).copy()
+        # zero any bits beyond n that a malicious peer may have set
+        extra = bs._words.size * 64 - n
+        if extra and bs._words.size:
+            keep = np.uint64((1 << (64 - extra)) - 1) if extra < 64 else np.uint64(0)
+            bs._words[-1] &= keep
+        return bs, 2 + nbytes
+
+    def __repr__(self) -> str:
+        return f"BitSet({self._n}, set={self.cardinality()})"
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, BitSet)
+            and self._n == other._n
+            and bool(np.all(self._words == other._words))
+        )

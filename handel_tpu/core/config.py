@@ -1,0 +1,93 @@
+"""Handel runtime configuration.
+
+Reference: config.go:12-165 — the `Config` struct with factory-closure
+injection points for every pluggable strategy, the defaults
+(DefaultContributionsPerc=51, DefaultCandidateCount=10, DefaultUpdatePeriod=10ms,
+DefaultUpdateCount=1, config.go:87-97), merge-with-default (:128-165), and
+`PercentageToContributions` (:124-126).
+
+Additions for the TPU build: `batch_size` (max signatures per device verify
+launch) and `verifier` (an async batch-verify service shared across co-located
+logical nodes, see parallel/batch_verifier.py).
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from handel_tpu.core.bitset import BitSet
+from handel_tpu.core.logging import DEFAULT_LOGGER, Logger
+from handel_tpu.core.partitioner import BinomialPartitioner
+
+DEFAULT_CONTRIBUTIONS_PERC = 51  # config.go:87
+DEFAULT_CANDIDATE_COUNT = 10  # FastPath fanout, config.go:90
+DEFAULT_UPDATE_PERIOD = 0.010  # seconds, config.go:93
+DEFAULT_UPDATE_COUNT = 1  # config.go:97
+DEFAULT_LEVEL_TIMEOUT = 0.050  # seconds, timeout.go:31
+DEFAULT_BATCH_SIZE = 16  # TPU verify batch per launch
+
+
+def percentage_to_contributions(perc: int, n: int) -> int:
+    """Exact contribution count for a percentage threshold (config.go:124-126)."""
+    return math.ceil(n * perc / 100.0)
+
+
+@dataclass
+class Config:
+    """Runtime knobs + factories for pluggable strategies."""
+
+    # minimum contributions in an output multisignature (config.go:19)
+    contributions: int = 0
+    # seconds between periodic update gossip rounds (config.go:23)
+    update_period: float = DEFAULT_UPDATE_PERIOD
+    # peers contacted per periodic update per level (config.go:27)
+    update_count: int = DEFAULT_UPDATE_COUNT
+    # peers contacted when a level completes — the fast path (config.go:31)
+    fast_path: int = DEFAULT_CANDIDATE_COUNT
+    # seconds between successive level starts (timeout.go:31)
+    level_timeout: float = DEFAULT_LEVEL_TIMEOUT
+
+    new_bitset: Callable[[int], BitSet] = BitSet
+    new_partitioner: Callable = BinomialPartitioner
+    # (store, handel) -> SigEvaluator; default = the store itself
+    new_evaluator: Optional[Callable] = None
+    # (handel, levels) -> TimeoutStrategy; default = LinearTimeout
+    new_timeout: Optional[Callable] = None
+
+    logger: Logger = DEFAULT_LOGGER
+    # entropy for per-level candidate shuffling (config.go:55)
+    rand: random.Random = field(default_factory=random.Random)
+    # debugging: keep candidate lists in registry order (config.go:59)
+    disable_shuffling: bool = False
+    # test knob: replace verification by a sleep of this many ms (config.go:61-65)
+    unsafe_sleep_on_verify_ms: int = 0
+
+    # -- TPU batch plane ---------------------------------------------------
+    # max candidates per device verification launch
+    batch_size: int = DEFAULT_BATCH_SIZE
+    # shared async batch-verify service (parallel/batch_verifier.py); None
+    # means verify through the scheme's own batch_verify
+    verifier: Optional[Callable] = None
+
+
+def default_config(num_nodes: int) -> Config:
+    """DefaultConfig (config.go:69-83)."""
+    c = Config()
+    c.contributions = percentage_to_contributions(
+        DEFAULT_CONTRIBUTIONS_PERC, num_nodes
+    )
+    return c
+
+
+def merge_with_default(c: Config | None, num_nodes: int) -> Config:
+    """Fill unset fields from defaults (config.go:128-165)."""
+    if c is None:
+        return default_config(num_nodes)
+    if c.contributions == 0:
+        c.contributions = percentage_to_contributions(
+            DEFAULT_CONTRIBUTIONS_PERC, num_nodes
+        )
+    return c

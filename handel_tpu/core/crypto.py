@@ -1,0 +1,156 @@
+"""Signature-scheme interfaces and the MultiSignature wire object.
+
+Reference: crypto.go:14-137 — `PublicKey`/`SecretKey`/`Signature`/`Constructor`
+interfaces, `MultiSignature` (bitset + aggregate signature) with its
+length-prefixed wire format (crypto.go:65-110), and `VerifyMultiSignature`
+(crypto.go:120-137).
+
+TPU-first notes:
+  * Schemes may implement `batch_verify` / `aggregate_public_keys` so the
+    processing pipeline can hand a whole batch of candidate multisignatures to
+    the device in one launch (SURVEY.md §2.1 "TPU plan" for processing.go).
+  * `verify_multisignature`'s pubkey-sum loop (crypto.go:126-134) goes through
+    `Constructor.aggregate_public_keys`, which a TPU scheme implements as a
+    masked G2 segment-sum kernel instead of a Python loop.
+"""
+
+from __future__ import annotations
+
+from typing import Protocol, Sequence, runtime_checkable
+
+from handel_tpu.core.bitset import BitSet
+
+
+@runtime_checkable
+class Signature(Protocol):
+    """An individual or aggregate signature (crypto.go:46-56)."""
+
+    def marshal(self) -> bytes: ...
+
+    def combine(self, other: "Signature") -> "Signature":
+        """Aggregate (not verify) this signature with another one."""
+        ...
+
+
+@runtime_checkable
+class PublicKey(Protocol):
+    """A public key (crypto.go:14-27)."""
+
+    def marshal(self) -> bytes: ...
+
+    def verify(self, msg: bytes, sig: Signature) -> bool: ...
+
+    def combine(self, other: "PublicKey") -> "PublicKey": ...
+
+
+@runtime_checkable
+class SecretKey(Protocol):
+    """A secret key (crypto.go:36-41)."""
+
+    def sign(self, msg: bytes) -> Signature: ...
+
+
+class Constructor:
+    """Factory for a signature scheme's objects (crypto.go:29-44).
+
+    Subclasses implement `unmarshal_signature`/`signature_size` and may
+    override the batch helpers with device kernels. Concrete schemes:
+    models/fake.py, models/bn254.py (pure python), models/bn254_native.py
+    (C++), models/bn254_jax.py (TPU), models/bls12_381.py.
+    """
+
+    def unmarshal_signature(self, data: bytes) -> Signature:
+        raise NotImplementedError
+
+    def signature_size(self) -> int:
+        """Fixed wire size of one (possibly aggregate) signature in bytes."""
+        raise NotImplementedError
+
+    # -- batch extensions (TPU path; optional for host-only schemes) -------
+
+    def aggregate_public_keys(
+        self, keys: Sequence[PublicKey], bitset: BitSet
+    ) -> PublicKey:
+        """Sum of `keys[i]` for every set bit i (crypto.go:126-134 loop)."""
+        agg = None
+        for i in bitset.indices():
+            agg = keys[i] if agg is None else agg.combine(keys[i])
+        if agg is None:
+            raise ValueError("empty bitset: no public keys to aggregate")
+        return agg
+
+    def batch_verify(
+        self,
+        msg: bytes,
+        pubkeys: Sequence[PublicKey],
+        requests: Sequence[tuple[BitSet, Signature]],
+    ) -> list[bool]:
+        """Verify many (bitset, aggregate signature) candidates against one msg.
+
+        Default: serial aggregate-then-verify (what the reference does once per
+        signature in processing.go:342-368). Device schemes override this with a
+        single batched multi-pairing launch.
+        """
+        out = []
+        for bs, sig in requests:
+            if bs.cardinality() == 0:
+                out.append(False)
+                continue
+            agg = self.aggregate_public_keys(pubkeys, bs)
+            out.append(agg.verify(msg, sig))
+        return out
+
+
+class MultiSignature:
+    """A (bitset, aggregate signature) pair — the protocol's unit of gossip.
+
+    Wire format (crypto.go:65-110): marshaled bitset (uint16 bit-length prefix,
+    bitset.go:150-177) followed by the fixed-size signature bytes.
+    """
+
+    __slots__ = ("bitset", "signature")
+
+    def __init__(self, bitset: BitSet, signature: Signature):
+        self.bitset = bitset
+        self.signature = signature
+
+    def cardinality(self) -> int:
+        return self.bitset.cardinality()
+
+    def marshal(self) -> bytes:
+        return self.bitset.marshal() + self.signature.marshal()
+
+    @classmethod
+    def unmarshal(cls, data: bytes, constructor: Constructor) -> "MultiSignature":
+        bs, used = BitSet.unmarshal(data)
+        sig_bytes = data[used:]
+        if len(sig_bytes) < constructor.signature_size():
+            raise ValueError("multisignature wire data truncated")
+        sig = constructor.unmarshal_signature(
+            sig_bytes[: constructor.signature_size()]
+        )
+        return cls(bs, sig)
+
+    def __repr__(self) -> str:
+        return f"MultiSignature(bits={self.bitset!r})"
+
+
+def verify_multisignature(
+    msg: bytes,
+    ms: MultiSignature,
+    registry: "Registry",  # noqa: F821 - circular, typed loosely
+    constructor: Constructor,
+) -> bool:
+    """Registry-wide final verification (crypto.go:120-137).
+
+    Aggregates the public keys of every signer in `ms.bitset` (over the full
+    registry) and checks the aggregate signature against `msg`.
+    """
+    n = registry.size()
+    if len(ms.bitset) != n:
+        return False
+    if ms.bitset.cardinality() == 0:
+        return False
+    keys = [registry.identity(i).public_key for i in range(n)]
+    agg = constructor.aggregate_public_keys(keys, ms.bitset)
+    return agg.verify(msg, ms.signature)

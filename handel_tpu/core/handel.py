@@ -1,0 +1,359 @@
+"""The Handel protocol state machine.
+
+Reference: handel.go:15-598 — the `Handel` struct, packet validation/parsing
+(:127-152, :373-436), the four concurrent loops started by `Start()` (:156-164),
+periodic updates (:167-225), the actor pattern (:257-328: checkCompletedLevel +
+checkFinalSignature), per-level send state (:443-580), and level creation with
+seeded shuffling (:498-519).
+
+Concurrency redesign: the reference runs four goroutines per node under one
+global mutex; here each node is a set of asyncio tasks on a single event loop —
+no locks, and thousands of logical nodes can share one loop (and one device
+batch queue) in-process. Verified signatures flow back via a direct callback
+(`_on_verified`) instead of a channel.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Sequence
+
+from handel_tpu.core.config import Config, merge_with_default
+from handel_tpu.core.crypto import Constructor, MultiSignature, Signature
+from handel_tpu.core.identity import Identity, Registry, shuffle
+from handel_tpu.core.net import Network, Packet
+from handel_tpu.core.partitioner import IncomingSig
+from handel_tpu.core.processing import BatchProcessing
+from handel_tpu.core.store import SignatureStore
+from handel_tpu.core.timeout import LinearTimeout
+
+
+class Level:
+    """Per-level send/receive state (handel.go:443-580)."""
+
+    def __init__(self, id: int, nodes: Sequence[Identity], send_expected_full_size: int):
+        if id <= 0:
+            raise ValueError("level id must be >= 1")
+        self.id = id
+        self.nodes = list(nodes)
+        self.send_started = False
+        self.rcv_completed = False
+        self.send_pos = 0
+        self.send_peers_ct = 0
+        self.send_expected_full_size = send_expected_full_size
+        self.send_sig_size = 0
+
+    def active(self) -> bool:
+        """Started and not yet done contacting every peer with the current
+        signature (handel.go:526-528)."""
+        return self.send_started and self.send_peers_ct < len(self.nodes)
+
+    def set_started(self) -> None:
+        self.send_started = True
+
+    def select_next_peers(self, count: int) -> list[Identity]:
+        """Rolling window over the (shuffled) peer list (handel.go:544-558)."""
+        size = min(count, len(self.nodes))
+        res = []
+        for _ in range(size):
+            res.append(self.nodes[self.send_pos])
+            self.send_pos = (self.send_pos + 1) % len(self.nodes)
+        self.send_peers_ct += size
+        return res
+
+    def update_sig_to_send(self, sig: MultiSignature) -> bool:
+        """Track the best signature we can send at this level; reset the peer
+        counter on improvement so the better sig propagates. Returns True when
+        the sendable signature is complete (fast-path start, handel.go:565-580)."""
+        card = sig.cardinality()
+        if self.send_sig_size >= card:
+            return False
+        self.send_sig_size = card
+        self.send_peers_ct = 0
+        if self.send_sig_size == self.send_expected_full_size:
+            self.set_started()
+            return True
+        return False
+
+
+def create_levels(config: Config, partitioner) -> dict[int, Level]:
+    """Build all levels, shuffling candidate order per level (handel.go:498-519).
+
+    send_expected_full_size accumulates 1 (own sig) + the sizes of all lower
+    levels — the complete signature one can send at each level.
+    """
+    levels: dict[int, Level] = {}
+    first_active = False
+    send_expected_full_size = 1
+    for lvl in partitioner.levels():
+        nodes = list(partitioner.identities_at(lvl))
+        if not config.disable_shuffling:
+            shuffle(nodes, config.rand)
+        levels[lvl] = Level(lvl, nodes, send_expected_full_size)
+        send_expected_full_size += len(nodes)
+        if not first_active:
+            levels[lvl].set_started()
+            first_active = True
+    return levels
+
+
+class Handel:
+    """One logical aggregation node (handel.go:15-62).
+
+    Consume final multisignatures from `final_signatures` (an asyncio.Queue,
+    the reference's FinalSignatures() channel, handel.go:230-232).
+    """
+
+    def __init__(
+        self,
+        network: Network,
+        registry: Registry,
+        identity: Identity,
+        constructor: Constructor,
+        msg: bytes,
+        own_sig: Signature,
+        config: Config | None = None,
+    ):
+        self.c = merge_with_default(config, registry.size())
+        self.net = network
+        self.reg = registry
+        self.id = identity
+        self.cons = constructor
+        self.msg = msg
+        self.sig = own_sig
+        self.log = self.c.logger.with_fields(id=identity.id)
+
+        self.partitioner = self.c.new_partitioner(identity.id, registry, self.log)
+        self.levels = create_levels(self.c, self.partitioner)
+        self.ids = self.partitioner.levels()
+        self.threshold = self.c.contributions
+        self.done = False
+        self.best: MultiSignature | None = None
+        self.final_signatures: asyncio.Queue[MultiSignature] = asyncio.Queue()
+        self.start_time = 0.0
+
+        self.store = SignatureStore(self.partitioner, self.c.new_bitset, constructor)
+        # our own signature seeds the store at level 0 (handel.go:108-116)
+        first_bs = self.c.new_bitset(1)
+        first_bs.set(0, True)
+        self.store.store(
+            IncomingSig(
+                origin=identity.id,
+                level=0,
+                ms=MultiSignature(first_bs, own_sig),
+                is_ind=True,
+                mapped_index=0,
+            )
+        )
+
+        evaluator = (
+            self.c.new_evaluator(self.store, self)
+            if self.c.new_evaluator
+            else self.store
+        )
+        self.proc = BatchProcessing(
+            self.partitioner,
+            constructor,
+            msg,
+            registry.public_keys()
+            if hasattr(registry, "public_keys")
+            else [registry.identity(i).public_key for i in range(registry.size())],
+            evaluator,
+            self._on_verified,
+            batch_size=self.c.batch_size,
+            verifier=self.c.verifier,
+            unsafe_sleep_ms=self.c.unsafe_sleep_on_verify_ms,
+            logger=self.log,
+        )
+        self.net.register_listener(self)
+        self.timeout = (
+            self.c.new_timeout(self, self.ids)
+            if self.c.new_timeout
+            else LinearTimeout(self, self.ids, self.c.level_timeout)
+        )
+
+        # minimal stats (handel.go:594-598) + reporter hook
+        self.msg_sent_ct = 0
+        self.msg_rcv_ct = 0
+        self._periodic_task: asyncio.Task | None = None
+
+    # -- lifecycle (handel.go:156-182) -------------------------------------
+
+    def start(self) -> None:
+        """Start processing, timeouts and the periodic updater. Must be called
+        from a running asyncio event loop."""
+        self.start_time = time.monotonic()
+        self.proc.start()
+        self.timeout.start()
+        self._periodic_task = asyncio.get_running_loop().create_task(
+            self._periodic_loop()
+        )
+
+    def stop(self) -> None:
+        self.timeout.stop()
+        self.proc.stop()
+        if self._periodic_task is not None:
+            self._periodic_task.cancel()
+            self._periodic_task = None
+        self.done = True
+
+    async def _periodic_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.c.update_period)
+            self._periodic_update()
+
+    def _periodic_update(self) -> None:
+        """Gossip our best combined sig on every active level (handel.go:186-194)."""
+        for lvl in self.levels.values():
+            if lvl.active():
+                self._send_update(lvl, self.c.update_count)
+
+    # -- inbound path (handel.go:127-152) ----------------------------------
+
+    def new_packet(self, p: Packet) -> None:
+        if self.done:
+            return
+        try:
+            self._validate_packet(p)
+        except ValueError as e:
+            self.log.warn("invalid_packet", e)
+            return
+        try:
+            ms, ind = self._parse_signatures(p)
+        except ValueError as e:
+            self.log.warn("invalid_packet_multisig", e)
+            return
+        if not self.levels[p.level].rcv_completed:
+            self.proc.add(ms)
+            if ind is not None:
+                self.proc.add(ind)
+
+    def _validate_packet(self, p: Packet) -> None:
+        """Origin/level range checks (handel.go:373-386)."""
+        self.msg_rcv_ct += 1
+        if p.origin < 0 or p.origin >= self.reg.size():
+            raise ValueError("packet's origin out of range")
+        if p.level not in self.levels:
+            raise ValueError(f"invalid packet level {p.level}")
+
+    def _parse_signatures(
+        self, p: Packet
+    ) -> tuple[IncomingSig, IncomingSig | None]:
+        """Unmarshal + sanity-check the multisig and optional individual sig
+        (handel.go:390-436)."""
+        ms = MultiSignature.unmarshal(p.multisig, self.cons)
+        lvl = self.levels[p.level]
+        if len(ms.bitset) != len(lvl.nodes):
+            raise ValueError("invalid bitset size for given level")
+        if ms.bitset.cardinality() == 0:
+            raise ValueError("no signature in the bitset")
+        inc = IncomingSig(origin=p.origin, level=p.level, ms=ms)
+
+        if p.individual_sig is None:
+            return inc, None
+        individual = self.cons.unmarshal_signature(p.individual_sig)
+        level_index = self.partitioner.index_at_level(p.origin, p.level)
+        bs = self.c.new_bitset(len(lvl.nodes))
+        bs.set(level_index, True)
+        ind = IncomingSig(
+            origin=p.origin,
+            level=p.level,
+            ms=MultiSignature(bs, individual),
+            is_ind=True,
+            mapped_index=level_index,
+        )
+        return inc, ind
+
+    # -- verified-signature actors (handel.go:239-328) ---------------------
+
+    def _on_verified(self, sp: IncomingSig) -> None:
+        """Store the verified signature, then run the actors
+        (rangeOnVerified, handel.go:239-248)."""
+        self.store.store(sp)
+        self._check_completed_level(sp)
+        self._check_final_signature(sp)
+
+    def _check_final_signature(self, sp: IncomingSig) -> None:
+        """Emit a new best full signature above the threshold (handel.go:271-296)."""
+        sig = self.store.full_signature()
+        if sig is None or sig.cardinality() < self.threshold:
+            return
+        if self.best is not None and sig.cardinality() <= self.best.cardinality():
+            return
+        if self.done:
+            return
+        self.best = sig
+        self.log.info(
+            "new_sig",
+            f"{sig.cardinality()}/{self.threshold}/{self.reg.size()}",
+        )
+        self.final_signatures.put_nowait(sig)
+
+    def _check_completed_level(self, sp: IncomingSig) -> None:
+        """Mark levels receive-complete and fast-path-forward improved combined
+        signatures upward (handel.go:301-328)."""
+        lvl = self.levels[sp.level] if sp.level in self.levels else None
+        if lvl is not None:
+            if lvl.rcv_completed:
+                return
+            best = self.store.best(sp.level)
+            if best is not None and best.cardinality() == len(lvl.nodes):
+                self.log.debug("level_complete", sp.level)
+                lvl.rcv_completed = True
+
+        for lid, up in self.levels.items():
+            if lid < sp.level + 1:
+                continue
+            ms = self.store.combined(lid - 1)
+            if ms is not None and up.update_sig_to_send(ms):
+                self._send_update(up, self.c.fast_path)
+
+    # -- outbound path (handel.go:198-225, 343-368) ------------------------
+
+    def start_level(self, level: int) -> None:
+        """Timeout-strategy entry: begin sending for a level (handel.go:198-212)."""
+        lvl = self.levels.get(level)
+        if lvl is None or lvl.send_started:
+            return
+        lvl.set_started()
+        self._send_update(lvl, self.c.update_count)
+
+    def _send_update(self, lvl: Level, count: int) -> None:
+        """Send our best combined signature to the next `count` peers of the
+        level (handel.go:216-225)."""
+        ms = self.store.combined(lvl.id - 1)
+        if ms is None:
+            return
+        peers = lvl.select_next_peers(count)
+        # attach our individual sig until the level completes (handel.go:219-223)
+        ind = self.sig if not lvl.rcv_completed else None
+        self._send_to(lvl.id, peers, ms, ind)
+
+    def _send_to(
+        self,
+        level: int,
+        ids: Sequence[Identity],
+        ms: MultiSignature,
+        ind: Signature | None,
+    ) -> None:
+        if not ids:
+            return
+        self.msg_sent_ct += len(ids)
+        p = Packet(
+            origin=self.id.id,
+            level=level,
+            multisig=ms.marshal(),
+            individual_sig=ind.marshal() if ind is not None else None,
+        )
+        self.net.send(ids, p)
+
+    # -- reporting ---------------------------------------------------------
+
+    def values(self) -> dict[str, float]:
+        return {
+            "msgSentCt": float(self.msg_sent_ct),
+            "msgRcvCt": float(self.msg_rcv_ct),
+            **self.proc.values(),
+            **self.store.values(),
+        }

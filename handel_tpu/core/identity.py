@@ -1,0 +1,82 @@
+"""Node identities and the registry.
+
+Reference: identity.go:11-134 — `Identity` (address + public key + int32 id),
+`Registry` (size / identity(i) / identities(from,to)), the array-backed
+implementation, and the deterministic seeded shuffle (identity.go:116-125) used
+to randomize per-level candidate ordering.
+
+TPU-first note: a device-backed scheme additionally uploads the registry's
+public keys once as a dense array in device memory (SURVEY.md §2.1), so
+per-candidate aggregation is a masked segment-sum instead of host point adds;
+see models/bn254_jax.py.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Sequence
+
+from handel_tpu.core.crypto import PublicKey
+
+
+class Identity:
+    """A participant: network address + public key + dense integer id."""
+
+    __slots__ = ("id", "address", "public_key")
+
+    def __init__(self, id: int, address: str, public_key: PublicKey | None):
+        self.id = id
+        self.address = address
+        self.public_key = public_key
+
+    def __repr__(self) -> str:
+        return f"Identity(id={self.id}, addr={self.address!r})"
+
+
+class Registry:
+    """Registry interface (identity.go:24-31)."""
+
+    def size(self) -> int:
+        raise NotImplementedError
+
+    def identity(self, idx: int) -> Identity:
+        raise NotImplementedError
+
+    def identities(self, from_idx: int, to_idx: int) -> Sequence[Identity]:
+        """Identities in [from_idx, to_idx) — empty on out-of-range."""
+        raise NotImplementedError
+
+
+class ArrayRegistry(Registry):
+    """Dense array-backed registry (identity.go:60-98)."""
+
+    def __init__(self, identities: Sequence[Identity]):
+        self._ids = list(identities)
+        for i, ident in enumerate(self._ids):
+            if ident.id != i:
+                raise ValueError(f"registry identity {i} has id {ident.id}")
+
+    def size(self) -> int:
+        return len(self._ids)
+
+    def identity(self, idx: int) -> Identity:
+        return self._ids[idx]
+
+    def identities(self, from_idx: int, to_idx: int) -> Sequence[Identity]:
+        if from_idx < 0 or to_idx > len(self._ids) or from_idx > to_idx:
+            return []
+        return self._ids[from_idx:to_idx]
+
+    def public_keys(self) -> list[PublicKey]:
+        return [i.public_key for i in self._ids]
+
+
+def shuffle(items: list, seed_rng: random.Random) -> None:
+    """Deterministic in-place Fisher-Yates shuffle (identity.go:116-125).
+
+    Callers pass a `random.Random` seeded from Config.rand so that level
+    candidate orderings are reproducible across runs and in tests.
+    """
+    for i in range(len(items) - 1, 0, -1):
+        j = seed_rng.randrange(i + 1)
+        items[i], items[j] = items[j], items[i]

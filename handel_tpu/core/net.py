@@ -1,0 +1,64 @@
+"""Network abstraction: packets, transports, listeners.
+
+Reference: net.go:6-44 — `Network` (Send/RegisterListener), `Listener`
+(NewPacket), and `Packet{Origin int32, Level byte, MultiSig, IndividualSig}`.
+
+The wire codec here is a fixed binary layout (length-prefixed fields) rather
+than the reference's gob encoding (network/gobEncoding.go:10-32) — simpler,
+language-neutral, and cheap to parse. Transports live in handel_tpu/network/.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import Protocol, Sequence, runtime_checkable
+
+from handel_tpu.core.identity import Identity
+
+
+@dataclass
+class Packet:
+    """One protocol datagram (net.go:24-44)."""
+
+    origin: int  # global id of the sender
+    level: int  # level this packet's multisig belongs to
+    multisig: bytes  # marshaled MultiSignature
+    individual_sig: bytes | None = None  # optional marshaled individual sig
+
+    _HDR = struct.Struct(">iBHH")  # origin, level, len(multisig), len(indiv)
+
+    def encode(self) -> bytes:
+        ind = self.individual_sig or b""
+        return (
+            self._HDR.pack(self.origin, self.level, len(self.multisig), len(ind))
+            + self.multisig
+            + ind
+        )
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Packet":
+        if len(data) < cls._HDR.size:
+            raise ValueError("packet too short")
+        origin, level, ms_len, ind_len = cls._HDR.unpack_from(data)
+        off = cls._HDR.size
+        if len(data) < off + ms_len + ind_len:
+            raise ValueError("packet truncated")
+        ms = data[off : off + ms_len]
+        ind = data[off + ms_len : off + ms_len + ind_len] if ind_len else None
+        return cls(origin=origin, level=level, multisig=ms, individual_sig=ind)
+
+
+@runtime_checkable
+class Listener(Protocol):
+    """Consumer of inbound packets (net.go:16-19)."""
+
+    def new_packet(self, packet: Packet) -> None: ...
+
+
+class Network(Protocol):
+    """Point-to-point datagram plane (net.go:6-13)."""
+
+    def send(self, identities: Sequence[Identity], packet: Packet) -> None: ...
+
+    def register_listener(self, listener: Listener) -> None: ...

@@ -1,0 +1,188 @@
+"""Best-per-level signature store with merge/patch logic and verification scoring.
+
+Reference: store.go:14-282 — `SignatureStore` interface, the scoring function
+`unsafeEvaluate` (store.go:111-183) that prioritizes which unverified signatures
+are worth a pairing check, and `unsafeCheckMerge` (store.go:188-229) which
+merges non-overlapping multisigs and patches holes with already-verified
+individual signatures.
+
+The exact scoring/merging semantics matter for protocol convergence
+(SURVEY.md §7 hard part (d)); they are reproduced faithfully. Point additions go
+through `Signature.combine`, which device schemes batch (store.go:201,225 →
+batched G1 adds).
+
+Concurrency note: the reference store carries its own mutex (store.go:41)
+because goroutines race on it. Here every caller runs on one asyncio event
+loop, so no lock is needed — single-threaded discipline is the framework-wide
+design (SURVEY.md §5.2).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from handel_tpu.core.bitset import BitSet
+from handel_tpu.core.crypto import Constructor, MultiSignature
+from handel_tpu.core.partitioner import BinomialPartitioner, IncomingSig
+
+
+class SignatureStore:
+    """Store of the best verified multisignature per level.
+
+    Also the default `SigEvaluator` — the store knows best which candidate
+    signatures are worth verifying (store.go:14-18).
+    """
+
+    def __init__(
+        self,
+        partitioner: BinomialPartitioner,
+        new_bitset: Callable[[int], BitSet] = BitSet,
+        constructor: Constructor | None = None,
+    ):
+        self.part = partitioner
+        self.nbs = new_bitset
+        self.cons = constructor
+        # best multisignature per level (store.go:43)
+        self.best_by_level: dict[int, MultiSignature] = {}
+        self.highest = 0
+        # which individual sigs we have verified, per level (store.go:55)
+        self.indiv_verified: dict[int, BitSet] = {0: new_bitset(1)}
+        # the verified individual sigs themselves (store.go:58)
+        self.individual_sigs: dict[int, dict[int, MultiSignature]] = {0: {}}
+        for lvl in partitioner.levels():
+            self.indiv_verified[lvl] = new_bitset(partitioner.size_of(lvl))
+            self.individual_sigs[lvl] = {}
+        # reporter counters (report.go:80-87)
+        self.replace_trial = 0
+        self.success_replace = 0
+
+    # -- evaluation (store.go:101-183) -------------------------------------
+
+    def evaluate(self, sp: IncomingSig) -> int:
+        """Score an unverified signature: 0 = discard, higher = verify sooner."""
+        score = self._evaluate(sp)
+        if score < 0:
+            raise AssertionError("negative score")
+        return score
+
+    def _evaluate(self, sp: IncomingSig) -> int:
+        to_receive = self.part.size_of(sp.level)
+        cur_best = self.best_by_level.get(sp.level)
+
+        if cur_best is not None and to_receive == cur_best.cardinality():
+            return 0  # completed level: nothing more to gain
+        if sp.individual and self.indiv_verified[sp.level].get(sp.mapped_index):
+            return 0  # already verified this exact individual sig
+        if (
+            cur_best is not None
+            and not sp.individual
+            and cur_best.bitset.is_superset(sp.ms.bitset)
+        ):
+            return 0  # strictly dominated by what we already have
+
+        # what we'd have after patching with known-verified individual sigs
+        with_indiv = sp.ms.bitset.or_(self.indiv_verified[sp.level])
+        if cur_best is None:
+            new_total = with_indiv.cardinality()
+            added_sigs = new_total
+            combine_ct = new_total - sp.ms.cardinality()
+        elif sp.ms.bitset.intersection_cardinality(cur_best.bitset) != 0:
+            # overlap: would replace, not merge
+            new_total = with_indiv.cardinality()
+            added_sigs = new_total - cur_best.cardinality()
+            combine_ct = new_total - sp.ms.cardinality()
+        else:
+            # disjoint: merge with current best + verified individuals
+            final_set = with_indiv.or_(cur_best.bitset)
+            new_total = final_set.cardinality()
+            added_sigs = new_total - cur_best.cardinality()
+            combine_ct = final_set.xor(
+                cur_best.bitset.or_(sp.ms.bitset)
+            ).cardinality()
+
+        if added_sigs <= 0:
+            # no gain; keep individual sigs anyway for BFT patching
+            return 1 if sp.individual else 0
+        if new_total == to_receive:
+            # completes a level — top priority, lower levels first
+            return 1_000_000 - sp.level * 10 - combine_ct
+        # useful but incomplete: favor lower levels and bigger gains
+        return 100_000 - sp.level * 100 + added_sigs * 10 - combine_ct
+
+    # -- storage (store.go:82-99, 188-229) ---------------------------------
+
+    def store(self, sp: IncomingSig) -> MultiSignature | None:
+        """Save or merge a *verified* signature; returns the resulting best."""
+        if sp.individual:
+            if sp.ms.cardinality() != 1:
+                raise AssertionError("individual sig with cardinality != 1")
+            self.indiv_verified[sp.level].set(sp.mapped_index, True)
+            self.individual_sigs[sp.level][sp.mapped_index] = sp.ms
+
+        new_ms, should_store = self._check_merge(sp)
+        if should_store:
+            self.best_by_level[sp.level] = new_ms
+            if sp.level > self.highest:
+                self.highest = sp.level
+        return new_ms
+
+    def _check_merge(self, sp: IncomingSig) -> tuple[MultiSignature | None, bool]:
+        cur_best = self.best_by_level.get(sp.level)
+        if cur_best is None:
+            return sp.ms, True
+        self.replace_trial += 1
+
+        best = MultiSignature(sp.ms.bitset.clone(), sp.ms.signature)
+        merged = sp.ms.bitset.or_(cur_best.bitset)
+        if merged.cardinality() == cur_best.cardinality() + sp.ms.cardinality():
+            # disjoint: aggregate the two signatures
+            best = MultiSignature(
+                merged, cur_best.signature.combine(sp.ms.signature)
+            )
+
+        # patch holes with verified individual sigs (store.go:204-226)
+        vl = self.indiv_verified[sp.level]
+        patchable = best.bitset.and_(vl).xor(vl)
+        if patchable.cardinality() + best.cardinality() <= cur_best.cardinality():
+            return None, False
+
+        sig = best.signature
+        for pos in patchable.indices():
+            ind = self.individual_sigs[sp.level][pos]
+            best.bitset.set(pos, True)
+            sig = ind.signature.combine(sig)
+        best = MultiSignature(best.bitset, sig)
+        self.success_replace += 1
+        return best, True
+
+    # -- queries (store.go:231-262) ----------------------------------------
+
+    def best(self, level: int) -> MultiSignature | None:
+        return self.best_by_level.get(level)
+
+    def combined(self, level: int) -> MultiSignature | None:
+        """Best combination of all levels <= `level`, sized for level+1's
+        candidate set (store.go:248-262)."""
+        sigs = [
+            IncomingSig(origin=-1, level=lvl, ms=ms)
+            for lvl, ms in self.best_by_level.items()
+            if lvl <= level
+        ]
+        if level < self.part.max_level():
+            level += 1
+        return self.part.combine(sigs, level, self.nbs)
+
+    def full_signature(self) -> MultiSignature | None:
+        """Registry-sized combination of everything we have (store.go:238-246)."""
+        sigs = [
+            IncomingSig(origin=-1, level=lvl, ms=ms)
+            for lvl, ms in self.best_by_level.items()
+        ]
+        return self.part.combine_full(sigs, self.nbs)
+
+    def values(self) -> dict[str, float]:
+        """Reporter counters (report.go:80-87)."""
+        return {
+            "successReplace": float(self.success_replace),
+            "replaceTrial": float(self.replace_trial),
+        }

@@ -1,0 +1,12 @@
+"""Signature schemes (the framework's "model families").
+
+  fake.py          — boolean fake scheme for fast, deterministic protocol tests
+                     (reference: util_test.go:15-99)
+  bn254.py         — pure-Python BN254 BLS, the correctness ground truth
+                     (reference: bn256/go/bn256.go, bn256/cf/bn256.go)
+  bn254_native.py  — C++ host backend via ctypes (native/bn254.cpp)
+  bn254_jax.py     — batched JAX/TPU backend (ops/), the flagship compute path
+  bls12_381.py     — Eth2 curve behind the same Constructor interface
+  registry.py      — string -> constructor dispatch
+                     (reference: simul/lib/config.go:211-225)
+"""

@@ -1,0 +1,5 @@
+"""Small shared helpers (reference: utils.go:8-38)."""
+
+from handel_tpu.utils.math import log2_ceil, pow2, is_set
+
+__all__ = ["log2_ceil", "pow2", "is_set"]

@@ -1,0 +1,16 @@
+"""Test configuration.
+
+Tests never require the real TPU: JAX runs on CPU with 8 virtual devices so
+sharding/mesh tests exercise real multi-device code paths
+(xla_force_host_platform_device_count, see task spec / SURVEY.md §7).
+This must run before any `import jax` anywhere in the test session.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,78 @@
+"""BitSet semantics + wire format (reference: bitset_test.go)."""
+
+import pytest
+
+from handel_tpu.core.bitset import BitSet
+
+
+def test_set_get_cardinality():
+    bs = BitSet(70)
+    assert bs.cardinality() == 0 and bs.none()
+    bs.set(0)
+    bs.set(69)
+    bs.set(64)
+    assert bs.cardinality() == 3
+    assert bs.get(0) and bs.get(69) and bs.get(64)
+    assert not bs.get(1)
+    bs.set(64, False)
+    assert bs.cardinality() == 2
+    with pytest.raises(IndexError):
+        bs.get(70)
+    with pytest.raises(IndexError):
+        bs.set(70)
+
+
+def test_algebra():
+    a, b = BitSet(10), BitSet(10)
+    a.set(1), a.set(3)
+    b.set(3), b.set(5)
+    assert a.or_(b).indices() == [1, 3, 5]
+    assert a.and_(b).indices() == [3]
+    assert a.xor(b).indices() == [1, 5]
+    assert a.or_(b).is_superset(a)
+    assert not a.is_superset(b)
+    assert a.intersection_cardinality(b) == 1
+    with pytest.raises(ValueError):
+        a.or_(BitSet(11))
+
+
+def test_next_set_indices():
+    bs = BitSet(130)
+    for i in (0, 64, 129):
+        bs.set(i)
+    assert bs.next_set(0) == 0
+    assert bs.next_set(1) == 64
+    assert bs.next_set(65) == 129
+    assert bs.next_set(129) == 129
+    assert bs.indices() == [0, 64, 129]
+    empty = BitSet(16)
+    assert empty.next_set(0) is None
+
+
+def test_wire_roundtrip():
+    for n in (1, 7, 8, 9, 64, 65, 100):
+        bs = BitSet(n)
+        for i in range(0, n, 3):
+            bs.set(i)
+        data = bs.marshal()
+        out, used = BitSet.unmarshal(data)
+        assert used == len(data)
+        assert out == bs
+
+
+def test_unmarshal_clamps_overflow_bits():
+    # a malicious peer setting padding bits beyond the declared length must
+    # not corrupt cardinality (bitset.go unmarshal semantics)
+    bs = BitSet(4)
+    bs.set(0)
+    data = bytearray(bs.marshal())
+    data[-1] |= 0xF0  # set bits 4..7, beyond the 4-bit length
+    out, _ = BitSet.unmarshal(bytes(data))
+    assert out.cardinality() == 1
+
+
+def test_mask_bool():
+    bs = BitSet(5)
+    bs.set(2)
+    mask = bs.mask_bool(8)
+    assert mask.tolist() == [False, False, True, False, False, False, False, False]

@@ -1,0 +1,133 @@
+"""Binomial partitioner range/index/combine tables.
+
+Reference test model: partitioner_test.go:9-396 (range tables, level indexing,
+combine offset placement). Expected values below are hand-derived from the
+common-prefix-length construction, not copied.
+"""
+
+import pytest
+
+from handel_tpu.core.bitset import BitSet
+from handel_tpu.core.crypto import MultiSignature
+from handel_tpu.core.partitioner import (
+    BinomialPartitioner,
+    EmptyLevelError,
+    IncomingSig,
+    InvalidLevelError,
+)
+from handel_tpu.models.fake import FakeSignature, fake_registry
+
+
+def part(n, id):
+    return BinomialPartitioner(id, fake_registry(n))
+
+
+@pytest.mark.parametrize(
+    "n,id,level,expected",
+    [
+        # n=8, id=1 (0b001)
+        (8, 1, 0, (1, 2)),
+        (8, 1, 1, (0, 1)),
+        (8, 1, 2, (2, 4)),
+        (8, 1, 3, (4, 8)),
+        # n=8, id=5 (0b101)
+        (8, 5, 1, (4, 5)),
+        (8, 5, 2, (6, 8)),
+        (8, 5, 3, (0, 4)),
+        # n=6 (non power of two), id=0: level 3 truncated to size
+        (6, 0, 3, (4, 6)),
+        # n=6, id=5: level 3 is the lower half
+        (6, 5, 3, (0, 4)),
+        (6, 5, 1, (4, 5)),
+        # n=16, id=0
+        (16, 0, 4, (8, 16)),
+        (16, 0, 1, (1, 2)),
+    ],
+)
+def test_range_level(n, id, level, expected):
+    assert part(n, id).range_level(level) == expected
+
+
+def test_empty_level_non_power_of_two():
+    # n=6, id=5 (0b101): level 2 range is [6,8) which is beyond size -> empty
+    p = part(6, 5)
+    with pytest.raises(EmptyLevelError):
+        p.range_level(2)
+    assert p.size_of(2) == 0
+    assert p.levels() == [1, 3]
+
+
+def test_levels_full_power_of_two():
+    assert part(8, 0).levels() == [1, 2, 3]
+    assert part(16, 3).levels() == [1, 2, 3, 4]
+    assert part(1, 0).levels() == []
+
+
+def test_invalid_level():
+    p = part(8, 0)
+    with pytest.raises(InvalidLevelError):
+        p.range_level(5)
+    with pytest.raises(InvalidLevelError):
+        p.range_level(-1)
+
+
+def test_index_at_level():
+    p = part(8, 1)
+    # level 2 of id=1 covers [2,4)
+    assert p.index_at_level(2, 2) == 0
+    assert p.index_at_level(3, 2) == 1
+    with pytest.raises(ValueError):
+        p.index_at_level(4, 2)  # out of level range: bug or attack
+
+
+def test_range_level_inverse():
+    p = part(8, 1)
+    # own subtree at level 3 = lower half [0,4); at level 1 = own id
+    assert p.range_level_inverse(3) == (0, 4)
+    assert p.range_level_inverse(1) == (1, 2)
+    # level 4 = whole registry
+    assert p.range_level_inverse(4) == (0, 8)
+
+
+def _inc(level, bits, size):
+    bs = BitSet(size)
+    for b in bits:
+        bs.set(b)
+    return IncomingSig(origin=-1, level=level, ms=MultiSignature(bs, FakeSignature()))
+
+
+def test_combine_offsets():
+    # id=1, n=8: combining level-0 (own, [1,2)) and level-1 ([0,1)) and
+    # level-2 ([2,4)) sigs for sending to level 3 -> bitset over [0,4)
+    p = part(8, 1)
+    sigs = [
+        _inc(0, [0], 1),  # own sig: global id 1
+        _inc(1, [0], 1),  # peer 0
+        _inc(2, [0, 1], 2),  # peers 2,3
+    ]
+    ms = p.combine(sigs, 3)
+    assert len(ms.bitset) == 4
+    assert ms.bitset.indices() == [0, 1, 2, 3]
+
+
+def test_combine_rejects_higher_level():
+    p = part(8, 1)
+    assert p.combine([_inc(3, [0], 4)], 2) is None
+
+
+def test_combine_full_offsets():
+    p = part(8, 5)
+    sigs = [
+        _inc(0, [0], 1),  # own sig -> global 5
+        _inc(1, [0], 1),  # level 1 covers [4,5)
+        _inc(3, [1, 3], 4),  # level 3 covers [0,4) -> globals 1,3
+    ]
+    ms = p.combine_full(sigs)
+    assert len(ms.bitset) == 8
+    assert ms.bitset.indices() == [1, 3, 4, 5]
+
+
+def test_combine_empty():
+    p = part(8, 1)
+    assert p.combine([], 2) is None
+    assert p.combine_full([]) is None

@@ -1,0 +1,81 @@
+"""Multi-node protocol integration with fake crypto over the in-process network.
+
+Reference model: handel_test.go:30-127 (TestHandelWithFailures,
+TestHandelTestNetworkFull — powers of two and not, offline nodes, thresholds),
+using the tier-2 strategy from SURVEY.md §4: no real crypto, no real sockets,
+and with zero offline nodes the timeout strategy is infinite so any stall is a
+real bug.
+"""
+
+import asyncio
+
+import pytest
+
+from handel_tpu.core.crypto import verify_multisignature
+from handel_tpu.core.test_harness import LocalCluster, run_cluster
+from handel_tpu.models.fake import FakeConstructor
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.mark.parametrize("n", [2, 4, 8, 13, 32])
+def test_full_aggregation(n):
+    results = run(run_cluster(n, timeout=15.0))
+    assert len(results) == n
+    for sig in results.values():
+        assert sig.cardinality() >= (n * 51 + 99) // 100
+
+
+def test_non_power_of_two_large():
+    results = run(run_cluster(21, timeout=15.0))
+    assert all(s.cardinality() >= 11 for s in results.values())
+
+
+@pytest.mark.parametrize(
+    "n,offline,threshold",
+    [
+        (8, (1, 5), 6),
+        (16, (0, 7, 12), 13),
+        (13, (2,), 10),
+    ],
+)
+def test_with_failures(n, offline, threshold):
+    async def go():
+        cluster = LocalCluster(n, offline=offline, threshold=threshold)
+        cluster.start()
+        try:
+            return await cluster.wait_complete_success(timeout=20.0)
+        finally:
+            cluster.stop()
+
+    results = run(go())
+    assert len(results) == n - len(offline)
+    for sig in results.values():
+        assert sig.cardinality() >= threshold
+        # offline nodes must not appear in the bitset
+        for off in offline:
+            assert not sig.bitset.get(off)
+
+
+def test_final_sig_verifies_against_registry():
+    async def go():
+        cluster = LocalCluster(8)
+        cluster.start()
+        try:
+            res = await cluster.wait_complete_success(timeout=15.0)
+            return cluster, res
+        finally:
+            cluster.stop()
+
+    cluster, results = run(go())
+    cons = FakeConstructor()
+    for sig in results.values():
+        assert verify_multisignature(b"hello world", sig, cluster.registry, cons)
+
+
+def test_larger_cluster_slow():
+    # reference: TestHandelTestNetworkLarge guarded by testing.Short()
+    results = run(run_cluster(64, timeout=30.0))
+    assert len(results) == 64

