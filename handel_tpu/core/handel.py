@@ -252,6 +252,8 @@ class Handel:
 
         if p.individual_sig is None:
             return inc, None
+        if len(p.individual_sig) != self.cons.signature_size():
+            raise ValueError("individual signature has wrong wire size")
         individual = self.cons.unmarshal_signature(p.individual_sig)
         level_index = self.partitioner.index_at_level(p.origin, p.level)
         bs = self.c.new_bitset(len(lvl.nodes))

@@ -1,0 +1,200 @@
+"""BLS signatures over BN254, pure-Python backend.
+
+Reference: bn256/go/bn256.go:1-218 and bn256/cf/bn256.go:1-219 — keys are G2
+points (X = x*B2), signatures are G1 points (S = x*H(m)), verification checks
+e(H(m), X) == e(S, B2), aggregation is plain point addition, and hash-to-G1
+derives a scalar from SHA256(msg) and multiplies the G1 base point
+(bn256/go/bn256.go:206-218 — the reference's known-scalar construction,
+mirrored here: k = SHA256(msg) mod r, H(m) = k*G1; same caveat as the
+reference's issue #122).
+
+Wire formats (64-byte G1 = x||y big-endian, 128-byte G2 with imaginary
+coefficient first, zero bytes = point at infinity) mirror cloudflare/bn256's
+Marshal layout.
+
+This scheme is the slow-but-oracle host path; bn254_native.py (C++) and
+bn254_jax.py (TPU) implement the same interface, verified against this one.
+The TPU-relevant structure is already here: `batch_verify` goes through one
+product-of-pairings check per candidate, which the device backend turns into a
+single vmap'd multi-pairing launch.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import secrets
+
+from handel_tpu.core.crypto import Constructor
+from handel_tpu.ops import bn254_ref as bn
+
+_G1_SIZE = 64
+_G2_SIZE = 128
+
+
+def _int_to_bytes(x: int) -> bytes:
+    return int(x).to_bytes(32, "big")
+
+
+def _bytes_to_int(b: bytes) -> int:
+    x = int.from_bytes(b, "big")
+    if x >= bn.P:
+        raise ValueError("coordinate >= field modulus")
+    return x
+
+
+def marshal_g1(pt) -> bytes:
+    if pt is None:
+        return b"\x00" * _G1_SIZE
+    return _int_to_bytes(pt[0]) + _int_to_bytes(pt[1])
+
+
+def unmarshal_g1(data: bytes):
+    if len(data) != _G1_SIZE:
+        raise ValueError(f"G1 point must be {_G1_SIZE} bytes")
+    if data == b"\x00" * _G1_SIZE:
+        return None
+    pt = (_bytes_to_int(data[:32]), _bytes_to_int(data[32:]))
+    if not bn.g1_is_valid(pt):
+        raise ValueError("G1 point not on curve")
+    return pt
+
+
+def marshal_g2(pt) -> bytes:
+    if pt is None:
+        return b"\x00" * _G2_SIZE
+    (x0, x1), (y0, y1) = pt
+    # imaginary-first coefficient order, as cloudflare/bn256 gfP2 marshals
+    return (
+        _int_to_bytes(x1) + _int_to_bytes(x0) + _int_to_bytes(y1) + _int_to_bytes(y0)
+    )
+
+
+def unmarshal_g2(data: bytes, check_subgroup: bool = True):
+    if len(data) != _G2_SIZE:
+        raise ValueError(f"G2 point must be {_G2_SIZE} bytes")
+    if data == b"\x00" * _G2_SIZE:
+        return None
+    x1, x0, y1, y0 = (_bytes_to_int(data[i : i + 32]) for i in range(0, 128, 32))
+    pt = ((x0, x1), (y0, y1))
+    if check_subgroup:
+        if not bn.g2_is_valid(pt):
+            raise ValueError("G2 point not on curve / wrong subgroup")
+    elif not bn.pt_is_on_curve(bn.F2_OPS, pt, bn.TWIST_B):
+        raise ValueError("G2 point not on curve")
+    return pt
+
+
+def hash_to_g1(msg: bytes):
+    """H(m) = (SHA256(m) mod r) * G1 — the reference's derivation
+    (bn256/go/bn256.go:206-218)."""
+    k = int.from_bytes(hashlib.sha256(msg).digest(), "big") % bn.R
+    if k == 0:
+        k = 1
+    return bn.g1_mul(bn.G1_GEN, k)
+
+
+class BN254Signature:
+    """A (possibly aggregate) signature: a G1 point (bn256/go/bn256.go SigBLS)."""
+
+    __slots__ = ("point",)
+
+    def __init__(self, point):
+        self.point = point
+
+    def marshal(self) -> bytes:
+        return marshal_g1(self.point)
+
+    def combine(self, other: "BN254Signature") -> "BN254Signature":
+        return BN254Signature(bn.g1_add(self.point, other.point))
+
+    def __eq__(self, other):
+        return isinstance(other, BN254Signature) and self.point == other.point
+
+
+class BN254PublicKey:
+    """A (possibly aggregate) public key: a G2 point."""
+
+    __slots__ = ("point",)
+
+    def __init__(self, point):
+        self.point = point
+
+    def marshal(self) -> bytes:
+        return marshal_g2(self.point)
+
+    def verify(self, msg: bytes, sig: BN254Signature) -> bool:
+        """e(H(m), X) == e(S, B2), as one product check
+        e(H(m), X) * e(-S, B2) == 1 (bn256/go/bn256.go:82-94)."""
+        if sig.point is None or self.point is None:
+            return False
+        hm = hash_to_g1(msg)
+        return bn.pairing_check(
+            [(hm, self.point), (bn.g1_neg(sig.point), bn.G2_GEN)]
+        )
+
+    def combine(self, other: "BN254PublicKey") -> "BN254PublicKey":
+        return BN254PublicKey(bn.g2_add(self.point, other.point))
+
+    def __eq__(self, other):
+        return isinstance(other, BN254PublicKey) and self.point == other.point
+
+
+class BN254SecretKey:
+    """The secret scalar x; public key X = x*B2, signature S = x*H(m)."""
+
+    __slots__ = ("scalar",)
+
+    def __init__(self, scalar: int):
+        self.scalar = scalar % bn.R
+
+    def public_key(self) -> BN254PublicKey:
+        return BN254PublicKey(bn.g2_mul(bn.G2_GEN, self.scalar))
+
+    def sign(self, msg: bytes) -> BN254Signature:
+        return BN254Signature(bn.g1_mul(hash_to_g1(msg), self.scalar))
+
+    def marshal(self) -> bytes:
+        return int(self.scalar).to_bytes(32, "big")
+
+    @classmethod
+    def unmarshal(cls, data: bytes) -> "BN254SecretKey":
+        return cls(int.from_bytes(data, "big"))
+
+
+def new_keypair(seed: int | None = None) -> tuple[BN254SecretKey, BN254PublicKey]:
+    """Generate a keypair; deterministic when `seed` is given (simulation
+    keygen, reference simul/lib/generator.go)."""
+    if seed is not None:
+        scalar = (
+            int.from_bytes(
+                hashlib.sha256(b"handel-tpu-key:" + str(seed).encode()).digest(),
+                "big",
+            )
+            % bn.R
+        )
+    else:
+        scalar = secrets.randbelow(bn.R - 1) + 1
+    sk = BN254SecretKey(scalar or 1)
+    return sk, sk.public_key()
+
+
+class BN254Constructor(Constructor):
+    """Scheme factory (bn256/go/bn256.go Constructor). Pure-Python verify path;
+    `batch_verify` is inherited serial aggregation + per-candidate product
+    pairing check."""
+
+    def unmarshal_signature(self, data: bytes) -> BN254Signature:
+        return BN254Signature(unmarshal_g1(data[:_G1_SIZE]))
+
+    def signature_size(self) -> int:
+        return _G1_SIZE
+
+
+class BN254Scheme:
+    """Keygen facade for the test harness / simulation keygen."""
+
+    def __init__(self):
+        self.constructor = BN254Constructor()
+
+    def keygen(self, i: int):
+        return new_keypair(seed=i)

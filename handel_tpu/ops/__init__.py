@@ -1,0 +1,9 @@
+"""Field/curve/pairing math: the TPU compute path and its scalar ground truth.
+
+  bn254_ref.py — pure-Python (bigint) BN254: tower fields, curve groups,
+                 optimal ate pairing. Correctness oracle for every kernel.
+  fp.py        — JAX limb-vector Fp arithmetic (Montgomery form)
+  tower.py     — JAX Fp2/Fp6/Fp12
+  curve.py     — JAX G1/G2 Jacobian ops, masked segment sums
+  pairing.py   — JAX Miller loop + final exponentiation, batched verify
+"""

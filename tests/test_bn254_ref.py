@@ -1,0 +1,151 @@
+"""BN254 scalar ground truth: field tower, curve groups, pairing properties.
+
+Reference test model: bn256/go/bn256_test.go + bn256/cf/bn256_test.go
+(sign/verify/combine/marshal round-trips), plus the algebraic properties the
+Go tests get for free from their audited dependency — here they must be
+proven: tower inverses, bilinearity, fast-vs-naive final exponentiation.
+"""
+
+import random
+
+import pytest
+
+from handel_tpu.ops import bn254_ref as bn
+
+rng = random.Random(1234)
+
+
+def rand_fp():
+    return rng.randrange(bn.P)
+
+
+def rand_f2():
+    return (rand_fp(), rand_fp())
+
+
+def rand_f6():
+    return (rand_f2(), rand_f2(), rand_f2())
+
+
+def rand_f12():
+    return (rand_f6(), rand_f6())
+
+
+def test_f2_field_axioms():
+    for _ in range(10):
+        a, b, c = rand_f2(), rand_f2(), rand_f2()
+        assert bn.f2_mul(a, bn.f2_add(b, c)) == bn.f2_add(
+            bn.f2_mul(a, b), bn.f2_mul(a, c)
+        )
+        assert bn.f2_mul(a, b) == bn.f2_mul(b, a)
+        assert bn.f2_sqr(a) == bn.f2_mul(a, a)
+        if a != bn.F2_ZERO:
+            assert bn.f2_mul(a, bn.f2_inv(a)) == bn.F2_ONE
+
+
+def test_f6_field_axioms():
+    for _ in range(5):
+        a, b = rand_f6(), rand_f6()
+        assert bn.f6_mul(a, b) == bn.f6_mul(b, a)
+        assert bn.f6_mul(a, bn.F6_ONE) == a
+        assert bn.f6_mul(a, bn.f6_inv(a)) == bn.F6_ONE
+        # v^3 == xi: multiplying three times by v equals multiplying by xi
+        threev = bn.f6_mul_v(bn.f6_mul_v(bn.f6_mul_v(a)))
+        xi_a = tuple(bn.f2_mul_xi(c) for c in a)
+        assert threev == xi_a
+
+
+def test_f12_field_axioms():
+    for _ in range(3):
+        a, b = rand_f12(), rand_f12()
+        assert bn.f12_mul(a, b) == bn.f12_mul(b, a)
+        assert bn.f12_mul(a, bn.f12_inv(a)) == bn.F12_ONE
+        assert bn.f12_sqr(a) == bn.f12_mul(a, a)
+
+
+def test_frobenius_is_p_power():
+    a = rand_f12()
+    assert bn.f12_frobenius(a) == bn.f12_pow(a, bn.P)
+
+
+def test_frobenius_conj_is_p6():
+    # x^(p^6) == conjugate for any Fp12 element
+    a = rand_f12()
+    f = a
+    for _ in range(6):
+        f = bn.f12_frobenius(f)
+    assert f == bn.f12_conj(a)
+
+
+def test_generators_valid():
+    assert bn.g1_is_valid(bn.G1_GEN)
+    assert bn.g2_is_valid(bn.G2_GEN)
+    assert bn.g1_mul(bn.G1_GEN, bn.R) is None
+    assert bn.g2_mul(bn.G2_GEN, bn.R) is None
+
+
+def test_group_ops():
+    p2 = bn.g1_add(bn.G1_GEN, bn.G1_GEN)
+    p3 = bn.g1_add(p2, bn.G1_GEN)
+    assert p3 == bn.g1_mul(bn.G1_GEN, 3)
+    assert bn.g1_add(p3, bn.g1_neg(p3)) is None
+    assert bn.g1_add(None, p2) == p2
+    q5 = bn.g2_mul(bn.G2_GEN, 5)
+    assert q5 == bn.g2_add(bn.g2_mul(bn.G2_GEN, 2), bn.g2_mul(bn.G2_GEN, 3))
+
+
+def test_pairing_bilinear():
+    a, b = rng.randrange(1, 10**9), rng.randrange(1, 10**9)
+    e = bn.pairing(bn.G2_GEN, bn.G1_GEN)
+    assert e != bn.F12_ONE
+    lhs = bn.pairing(bn.g2_mul(bn.G2_GEN, b), bn.g1_mul(bn.G1_GEN, a))
+    assert lhs == bn.f12_pow(e, a * b)
+    # e(P, Q)^r == 1 (GT has order r)
+    assert bn.f12_pow(e, bn.R) == bn.F12_ONE
+
+
+def test_fast_final_exp_matches_naive():
+    f = bn.miller_loop(bn.g2_mul(bn.G2_GEN, 7), bn.g1_mul(bn.G1_GEN, 11))
+    assert bn.final_exponentiation(f) == bn.final_exponentiation_naive(f)
+
+
+def _twist_point_outside_subgroup():
+    # find a point on E'(Fp2) NOT in the order-r subgroup (E' has a large
+    # cofactor, so almost any solved-for point qualifies)
+    for x0 in range(1, 50):
+        x = (x0, 0)
+        rhs = bn.f2_add(bn.f2_mul(bn.f2_sqr(x), x), bn.TWIST_B)
+        y = bn.f2_sqrt(rhs)
+        if y is None:
+            continue
+        pt = (x, y)
+        assert bn.pt_is_on_curve(bn.F2_OPS, pt, bn.TWIST_B)
+        if bn.pt_mul(bn.F2_OPS, pt, bn.R) is not None:
+            return pt
+    raise AssertionError("no out-of-subgroup twist point found")
+
+
+def test_rogue_g2_point_rejected():
+    # regression: pt_mul must not reduce the scalar mod R, else the subgroup
+    # check [R]P == O is vacuously true and rogue keys pass validation
+    rogue = _twist_point_outside_subgroup()
+    assert not bn.g2_is_valid(rogue)
+
+
+def test_f2_sqrt():
+    for _ in range(5):
+        a = rand_f2()
+        sq = bn.f2_sqr(a)
+        root = bn.f2_sqrt(sq)
+        assert root is not None and bn.f2_sqr(root) == sq
+
+
+def test_pairing_check_product():
+    p, q = bn.G1_GEN, bn.G2_GEN
+    assert bn.pairing_check([(p, q), (bn.g1_neg(p), q)])
+    assert not bn.pairing_check([(p, q), (p, q)])
+    # e(aP, Q) * e(-P, aQ) == 1
+    a = 424242
+    assert bn.pairing_check(
+        [(bn.g1_mul(p, a), q), (bn.g1_neg(p), bn.g2_mul(q, a))]
+    )

@@ -1,0 +1,102 @@
+"""BLS-over-BN254 scheme semantics (reference: bn256/*/bn256_test.go:39-99)."""
+
+import pytest
+
+from handel_tpu.core.bitset import BitSet
+from handel_tpu.models.bn254 import (
+    BN254Constructor,
+    BN254SecretKey,
+    hash_to_g1,
+    marshal_g2,
+    new_keypair,
+    unmarshal_g1,
+    unmarshal_g2,
+)
+
+MSG = b"attestation data"
+
+
+def test_sign_verify():
+    sk, pk = new_keypair(seed=1)
+    sig = sk.sign(MSG)
+    assert pk.verify(MSG, sig)
+    assert not pk.verify(b"other message", sig)
+    sk2, pk2 = new_keypair(seed=2)
+    assert not pk2.verify(MSG, sig)
+
+
+def test_aggregate_sign_verify():
+    # combine k signatures + pubkeys: aggregate verifies, partial doesn't
+    keys = [new_keypair(seed=i) for i in range(4)]
+    agg_sig = None
+    agg_pk = None
+    for sk, pk in keys:
+        s = sk.sign(MSG)
+        agg_sig = s if agg_sig is None else agg_sig.combine(s)
+        agg_pk = pk if agg_pk is None else agg_pk.combine(pk)
+    assert agg_pk.verify(MSG, agg_sig)
+    # dropping one pubkey must fail
+    partial_pk = keys[0][1].combine(keys[1][1]).combine(keys[2][1])
+    assert not partial_pk.verify(MSG, agg_sig)
+
+
+def test_marshal_roundtrip():
+    sk, pk = new_keypair(seed=7)
+    sig = sk.sign(MSG)
+    cons = BN254Constructor()
+    assert cons.signature_size() == 64
+    sig2 = cons.unmarshal_signature(sig.marshal())
+    assert sig2 == sig
+    pk2 = unmarshal_g2(pk.marshal())
+    assert pk2 == pk.point
+    assert len(pk.marshal()) == 128
+
+
+def test_unmarshal_rejects_off_curve():
+    with pytest.raises(ValueError):
+        unmarshal_g1(b"\x01" * 64)
+    with pytest.raises(ValueError):
+        unmarshal_g2(b"\x02" * 128)
+    # coordinate >= modulus rejected
+    with pytest.raises(ValueError):
+        unmarshal_g1(b"\xff" * 64)
+
+
+def test_hash_to_g1_deterministic():
+    from handel_tpu.ops import bn254_ref as bn
+
+    h1, h2 = hash_to_g1(MSG), hash_to_g1(MSG)
+    assert h1 == h2
+    assert bn.g1_is_valid(h1)
+    assert hash_to_g1(b"x") != hash_to_g1(b"y")
+
+
+def test_batch_verify_via_constructor():
+    cons = BN254Constructor()
+    keys = [new_keypair(seed=i) for i in range(4)]
+    pubkeys = [pk for _, pk in keys]
+    sigs = [sk.sign(MSG) for sk, _ in keys]
+
+    bs_all = BitSet(4)
+    for i in range(4):
+        bs_all.set(i)
+    agg = sigs[0].combine(sigs[1]).combine(sigs[2]).combine(sigs[3])
+
+    bs_one = BitSet(4)
+    bs_one.set(2)
+
+    bs_wrong = BitSet(4)
+    bs_wrong.set(0)  # claims signer 0 but carries signer 1's sig
+
+    out = cons.batch_verify(
+        MSG,
+        pubkeys,
+        [(bs_all, agg), (bs_one, sigs[2]), (bs_wrong, sigs[1])],
+    )
+    assert out == [True, True, False]
+
+
+def test_secret_key_marshal():
+    sk, _ = new_keypair(seed=3)
+    sk2 = BN254SecretKey.unmarshal(sk.marshal())
+    assert sk2.scalar == sk.scalar

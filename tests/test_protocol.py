@@ -75,6 +75,36 @@ def test_final_sig_verifies_against_registry():
         assert verify_multisignature(b"hello world", sig, cluster.registry, cons)
 
 
+def test_malformed_individual_sig_ignored():
+    # regression: wrong-size individual_sig must be rejected as an invalid
+    # packet, not crash the listener with a non-ValueError
+    from handel_tpu.core.net import Packet
+
+    from handel_tpu.core.bitset import BitSet
+    from handel_tpu.core.crypto import MultiSignature
+    from handel_tpu.models.fake import FakeSignature
+
+    async def go():
+        cluster = LocalCluster(8)
+        cluster.start()
+        h0 = cluster.handels[0]
+        # correctly-sized level-3 multisig (4 peers for id 0) so parsing
+        # reaches the malformed individual_sig
+        bs = BitSet(len(h0.levels[3].nodes))
+        bs.set(0)
+        good_ms = MultiSignature(bs, FakeSignature()).marshal()
+        h0.new_packet(
+            Packet(origin=4, level=3, multisig=good_ms, individual_sig=b"\x01\x02")
+        )
+        try:
+            return await cluster.wait_complete_success(timeout=15.0)
+        finally:
+            cluster.stop()
+
+    results = run(go())
+    assert len(results) == 8
+
+
 def test_larger_cluster_slow():
     # reference: TestHandelTestNetworkLarge guarded by testing.Short()
     results = run(run_cluster(64, timeout=30.0))

@@ -1,0 +1,36 @@
+"""Real-crypto protocol integration: BN254 BLS end-to-end over the in-process
+network (reference model: bn256/cf/bn256_test.go:13-37, a 37-node cluster;
+smaller here because the pure-Python oracle backend is ~100ms/verify — the
+JAX and C++ backends run the larger configs).
+"""
+
+import asyncio
+
+import pytest
+
+from handel_tpu.core.config import Config
+from handel_tpu.core.crypto import verify_multisignature
+from handel_tpu.core.test_harness import LocalCluster
+from handel_tpu.models.bn254 import BN254Scheme
+
+MSG = b"hello world"
+
+
+@pytest.mark.slow
+def test_bn254_end_to_end():
+    scheme = BN254Scheme()
+
+    async def go():
+        cluster = LocalCluster(8, scheme=scheme, msg=MSG)
+        cluster.start()
+        try:
+            res = await cluster.wait_complete_success(timeout=60.0)
+            return cluster, res
+        finally:
+            cluster.stop()
+
+    cluster, results = asyncio.run(go())
+    assert len(results) == 8
+    for sig in results.values():
+        assert sig.cardinality() >= cluster.threshold
+        assert verify_multisignature(MSG, sig, cluster.registry, scheme.constructor)
