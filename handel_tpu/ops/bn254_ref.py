@@ -475,6 +475,101 @@ def miller_loop(q, p):
     return f
 
 
+def miller_loop_projective(q, p):
+    """Inversion-free Miller loop — the formulation the JAX/TPU kernel uses
+    (ops/pairing.py), kept here in scalar form as its oracle.
+
+    The accumulator point T runs in homogeneous projective coordinates on the
+    twist E'(Fp2); lines are evaluated directly with Fp2 coefficients placed
+    into the sparse Fp12 slots (1, w, w^3). All scale factors introduced live
+    in Fp2 and die in the easy part of the final exponentiation.
+
+    Derivation (D-twist psi(x,y) = (x w^2, y w^3), slope transforms as
+    lambda' = lambda * w):
+      doubling at T=(X,Y,Z), line scaled by 2YZ^3:
+        l = 2YZ^2*yp - 3X^2 Z*xp w + (3X^3 - 2Y^2 Z) w^3
+        n = 3X^2, d = 2YZ, e = n^2 - 8XY^2 Z:
+        X' = e*d, Y' = n*(12XY^2 Z - n^2) - 8Y^4 Z^2, Z' = d^3
+      mixed addition T + Q=(x2,y2), n = y2 Z - Y, d = x2 Z - X, line scaled
+      by d:
+        l = d*yp - n*xp w + (n x2 - d y2) w^3
+        e = n^2 Z - (X + x2 Z) d^2:
+        X' = e*d, Y' = n*(x2 Z d^2 - e) - y2 Z d^3, Z' = Z d^3
+    """
+    if q is None or p is None:
+        return F12_ONE
+    xp, yp = p
+
+    def sparse_line(c0, cw, cw3):
+        # Fp12 slots: 1 -> a0.u0, w -> a1.u0, w^3 = v*w -> a1.u1
+        return ((c0, F2_ZERO, F2_ZERO), (cw, cw3, F2_ZERO))
+
+    def dbl(T):
+        X, Y, Z = T
+        XX = f2_sqr(X)
+        YY = f2_sqr(Y)
+        YZ = f2_mul(Y, Z)
+        n = f2_scalar(XX, 3)
+        d = f2_scalar(YZ, 2)
+        XYY = f2_mul(X, YY)
+        XYYZ = f2_mul(XYY, Z)
+        e = f2_sub(f2_sqr(n), f2_scalar(XYYZ, 8))
+        X3 = f2_mul(e, d)
+        Y3 = f2_sub(
+            f2_mul(n, f2_sub(f2_scalar(XYYZ, 12), f2_sqr(n))),
+            f2_scalar(f2_mul(f2_sqr(YY), f2_sqr(Z)), 8),
+        )
+        Z3 = f2_mul(f2_sqr(d), d)
+        c0 = f2_scalar(f2_mul(f2_mul(YZ, Z), (yp, 0)), 2)
+        cw = f2_neg(f2_mul(f2_mul(n, Z), (xp, 0)))
+        cw3 = f2_sub(f2_mul(n, X), f2_scalar(f2_mul(YY, Z), 2))
+        return (X3, Y3, Z3), sparse_line(c0, cw, cw3)
+
+    def add(T, Q2):
+        X, Y, Z = T
+        x2, y2 = Q2
+        n = f2_sub(f2_mul((y2[0], y2[1]), Z), Y)
+        d = f2_sub(f2_mul((x2[0], x2[1]), Z), X)
+        dd = f2_sqr(d)
+        x2Z = f2_mul(x2, Z)
+        e = f2_sub(f2_mul(f2_sqr(n), Z), f2_mul(f2_add(X, x2Z), dd))
+        X3 = f2_mul(e, d)
+        Y3 = f2_sub(
+            f2_mul(n, f2_sub(f2_mul(x2Z, dd), e)),
+            f2_mul(f2_mul(y2, Z), f2_mul(dd, d)),
+        )
+        Z3 = f2_mul(Z, f2_mul(dd, d))
+        c0 = f2_mul(d, ((yp % P), 0))
+        cw = f2_neg(f2_mul(n, ((xp % P), 0)))
+        cw3 = f2_sub(f2_mul(n, x2), f2_mul(d, y2))
+        return (X3, Y3, Z3), sparse_line(c0, cw, cw3)
+
+    T = (q[0], q[1], F2_ONE)
+    f = F12_ONE
+    for bit in bin(ATE_LOOP_COUNT)[3:]:
+        T, line = dbl(T)
+        f = f12_mul(f12_sqr(f), line)
+        if bit == "1":
+            T, line = add(T, q)
+            f = f12_mul(f, line)
+    # Frobenius corrections on the untwisted coordinates:
+    # psi-Frobenius on E': (x,y) -> (conj(x)*gamma_2', conj(y)*gamma_3') with
+    # gamma coefficients matching the w^2/w^3 slots of the lift.
+    q1 = (
+        f2_mul(f2_conj(q[0]), _GAMMA[2]),
+        f2_mul(f2_conj(q[1]), _GAMMA[3]),
+    )
+    q2 = (
+        f2_mul(f2_conj(q1[0]), _GAMMA[2]),
+        f2_neg(f2_mul(f2_conj(q1[1]), _GAMMA[3])),
+    )
+    T, line = add(T, q1)
+    f = f12_mul(f, line)
+    _, line = add(T, q2)
+    f = f12_mul(f, line)
+    return f
+
+
 def final_exponentiation_naive(f):
     """The oracle: f^((p^12-1)/r) by plain square-and-multiply."""
     return f12_pow(f, (P**12 - 1) // R)

@@ -1,0 +1,355 @@
+"""JAX extension-field tower Fp2 -> Fp6 -> Fp12 for BN254.
+
+Mirrors the scalar tower in ops/bn254_ref.py (the correctness oracle) on limb
+vectors. TPU-first structure: every tower multiplication flattens its
+independent base-field multiplications into the *batch* dimension and issues a
+single `Field.mul` call —
+
+    Fp12 mul = 3 Fp6 muls = 18 Fp2 muls = 54 Fp muls  ->  ONE mont_mul at 54xB
+
+so the Pallas kernel's lanes stay full even for small pairing batches
+(ops/fp.py "batch stacking beats vmap"). Elements are pytrees of (nlimbs, B)
+uint32 arrays: Fp2 = (c0, c1), Fp6 = (Fp2, Fp2, Fp2), Fp12 = (Fp6, Fp6).
+
+All values Montgomery-form, canonical (< p).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from handel_tpu.ops import bn254_ref as bn
+from handel_tpu.ops.fp import Field
+
+
+def _split3(x):
+    b = x.shape[1] // 3
+    return x[:, :b], x[:, b : 2 * b], x[:, 2 * b :]
+
+
+class Tower:
+    """Fp2/Fp6/Fp12 arithmetic over a base Field (BN254 tower shape:
+    i^2 = -1, v^3 = xi = 9+i, w^2 = v; bn254_ref.py)."""
+
+    def __init__(self, field: Field | None = None):
+        self.F = field or Field(bn.P)
+        # Frobenius constants gamma_j = xi^(j(p-1)/6) as Montgomery limb pairs
+        self._gamma = [None] + [
+            tuple(self.F.pack([g[0], g[1]])[:, i : i + 1] for i in range(2))
+            for g in bn._GAMMA[1:]
+        ]
+
+    # -- Fp2 ---------------------------------------------------------------
+
+    def f2_add(self, a, b):
+        return (self.F.add(a[0], b[0]), self.F.add(a[1], b[1]))
+
+    def f2_sub(self, a, b):
+        return (self.F.sub(a[0], b[0]), self.F.sub(a[1], b[1]))
+
+    def f2_neg(self, a):
+        return (self.F.neg(a[0]), self.F.neg(a[1]))
+
+    def f2_conj(self, a):
+        return (a[0], self.F.neg(a[1]))
+
+    def f2_mul(self, a, b):
+        """Karatsuba: 3 base muls in one stacked call.
+        (a0+a1 i)(b0+b1 i) = (a0b0 - a1b1) + ((a0+a1)(b0+b1) - a0b0 - a1b1) i
+        """
+        F = self.F
+        lhs = jnp.concatenate([a[0], a[1], F.add(a[0], a[1])], axis=1)
+        rhs = jnp.concatenate([b[0], b[1], F.add(b[0], b[1])], axis=1)
+        v0, v1, v2 = _split3(F.mul(lhs, rhs))
+        c0 = F.sub(v0, v1)
+        c1 = F.sub(F.sub(v2, v0), v1)
+        return (c0, c1)
+
+    def f2_sqr(self, a):
+        """(a0+a1 i)^2 = (a0+a1)(a0-a1) + 2 a0 a1 i — 2 base muls."""
+        F = self.F
+        lhs = jnp.concatenate([F.add(a[0], a[1]), a[0]], axis=1)
+        rhs = jnp.concatenate([F.sub(a[0], a[1]), a[1]], axis=1)
+        prod = F.mul(lhs, rhs)
+        b = prod.shape[1] // 2
+        c0 = prod[:, :b]
+        t = prod[:, b:]
+        return (c0, F.add(t, t))
+
+    def f2_mul_fp(self, a, s):
+        """Fp2 element times a base-field element (2 base muls, stacked)."""
+        F = self.F
+        prod = F.mul(
+            jnp.concatenate([a[0], a[1]], axis=1),
+            jnp.concatenate([s, s], axis=1),
+        )
+        b = prod.shape[1] // 2
+        return (prod[:, :b], prod[:, b:])
+
+    def f2_mul_xi(self, a):
+        """Multiply by xi = 9 + i via add chains (no base mul):
+        (9a0 - a1, 9a1 + a0)."""
+        F = self.F
+
+        def x9(x):
+            x2 = F.add(x, x)
+            x4 = F.add(x2, x2)
+            x8 = F.add(x4, x4)
+            return F.add(x8, x)
+
+        return (F.sub(x9(a[0]), a[1]), F.add(x9(a[1]), a[0]))
+
+    def f2_inv(self, a):
+        """1/(a0+a1 i) = (a0 - a1 i)/(a0^2+a1^2)."""
+        F = self.F
+        den = F.add(F.mul(a[0], a[0]), F.mul(a[1], a[1]))
+        inv = F.inv(den)
+        return (F.mul(a[0], inv), F.neg(F.mul(a[1], inv)))
+
+    def f2_select(self, mask, a, b):
+        return (self.F.select(mask, a[0], b[0]), self.F.select(mask, a[1], b[1]))
+
+    def f2_eq(self, a, b):
+        return self.F.eq(a[0], b[0]) & self.F.eq(a[1], b[1])
+
+    def f2_is_zero(self, a):
+        return self.F.is_zero(a[0]) & self.F.is_zero(a[1])
+
+    def f2_zero(self, batch: int):
+        z = jnp.zeros((self.F.nlimbs, batch), jnp.uint32)
+        return (z, z)
+
+    def f2_one(self, batch: int):
+        return (self.F.constant(1, batch), jnp.zeros((self.F.nlimbs, batch), jnp.uint32))
+
+    def f2_constant(self, c, batch: int):
+        """Embed a bn254_ref Fp2 value (int pair) as broadcast limbs."""
+        return (
+            jnp.broadcast_to(self.F.pack([c[0]]), (self.F.nlimbs, batch)),
+            jnp.broadcast_to(self.F.pack([c[1]]), (self.F.nlimbs, batch)),
+        )
+
+    # -- Fp2 stacking helpers ----------------------------------------------
+
+    def _f2_stack(self, elems):
+        """Concatenate Fp2 elements along the batch axis."""
+        return (
+            jnp.concatenate([e[0] for e in elems], axis=1),
+            jnp.concatenate([e[1] for e in elems], axis=1),
+        )
+
+    def _f2_unstack(self, e, k):
+        b = e[0].shape[1] // k
+        return [
+            (e[0][:, i * b : (i + 1) * b], e[1][:, i * b : (i + 1) * b])
+            for i in range(k)
+        ]
+
+    # -- Fp6 ---------------------------------------------------------------
+
+    def f6_add(self, a, b):
+        return tuple(self.f2_add(x, y) for x, y in zip(a, b))
+
+    def f6_sub(self, a, b):
+        return tuple(self.f2_sub(x, y) for x, y in zip(a, b))
+
+    def f6_neg(self, a):
+        return tuple(self.f2_neg(x) for x in a)
+
+    def f6_mul(self, a, b):
+        """Toom/Karatsuba: 6 Fp2 muls in ONE stacked f2_mul call
+        (bn254_ref.f6_mul structure)."""
+        a0, a1, a2 = a
+        b0, b1, b2 = b
+        lhs = self._f2_stack(
+            [a0, a1, a2, self.f2_add(a1, a2), self.f2_add(a0, a1), self.f2_add(a0, a2)]
+        )
+        rhs = self._f2_stack(
+            [b0, b1, b2, self.f2_add(b1, b2), self.f2_add(b0, b1), self.f2_add(b0, b2)]
+        )
+        t0, t1, t2, u0, u1, u2 = self._f2_unstack(self.f2_mul(lhs, rhs), 6)
+        c0 = self.f2_add(
+            t0, self.f2_mul_xi(self.f2_sub(u0, self.f2_add(t1, t2)))
+        )
+        c1 = self.f2_add(
+            self.f2_sub(u1, self.f2_add(t0, t1)), self.f2_mul_xi(t2)
+        )
+        c2 = self.f2_add(self.f2_sub(u2, self.f2_add(t0, t2)), t1)
+        return (c0, c1, c2)
+
+    def f6_mul_v(self, a):
+        """(c0,c1,c2) * v = (xi*c2, c0, c1)."""
+        return (self.f2_mul_xi(a[2]), a[0], a[1])
+
+    def f6_inv(self, a):
+        """bn254_ref.f6_inv structure."""
+        a0, a1, a2 = a
+        t0 = self.f2_sub(self.f2_sqr(a0), self.f2_mul_xi(self.f2_mul(a1, a2)))
+        t1 = self.f2_sub(self.f2_mul_xi(self.f2_sqr(a2)), self.f2_mul(a0, a1))
+        t2 = self.f2_sub(self.f2_sqr(a1), self.f2_mul(a0, a2))
+        den = self.f2_add(
+            self.f2_mul(a0, t0),
+            self.f2_mul_xi(
+                self.f2_add(self.f2_mul(a2, t1), self.f2_mul(a1, t2))
+            ),
+        )
+        inv = self.f2_inv(den)
+        return (self.f2_mul(t0, inv), self.f2_mul(t1, inv), self.f2_mul(t2, inv))
+
+    def f6_zero(self, batch):
+        return (self.f2_zero(batch),) * 3
+
+    def f6_one(self, batch):
+        return (self.f2_one(batch), self.f2_zero(batch), self.f2_zero(batch))
+
+    def f6_select(self, mask, a, b):
+        return tuple(self.f2_select(mask, x, y) for x, y in zip(a, b))
+
+    # -- Fp12 --------------------------------------------------------------
+
+    def f12_mul(self, a, b):
+        """Karatsuba over Fp6: 3 Fp6 muls -> one stacked f6_mul (54x batch)."""
+        a0, a1 = a
+        b0, b1 = b
+        lhs = tuple(
+            self._f2_stack([a0[i], a1[i], self.f2_add(a0[i], a1[i])])
+            for i in range(3)
+        )
+        rhs = tuple(
+            self._f2_stack([b0[i], b1[i], self.f2_add(b0[i], b1[i])])
+            for i in range(3)
+        )
+        prod = self.f6_mul(lhs, rhs)
+        v0, v1, v2 = zip(*(self._f2_unstack(c, 3) for c in prod))
+        v0, v1, v2 = tuple(v0), tuple(v1), tuple(v2)
+        c0 = self.f6_add(v0, self.f6_mul_v(v1))
+        c1 = self.f6_sub(self.f6_sub(v2, v0), v1)
+        return (c0, c1)
+
+    def f12_sqr(self, a):
+        return self.f12_mul(a, a)
+
+    def f12_add(self, a, b):
+        return (self.f6_add(a[0], b[0]), self.f6_add(a[1], b[1]))
+
+    def f12_conj(self, a):
+        return (a[0], self.f6_neg(a[1]))
+
+    def f12_inv(self, a):
+        den = self.f6_inv(
+            self.f6_sub(
+                self._f6_sqr_via_mul(a[0]), self.f6_mul_v(self._f6_sqr_via_mul(a[1]))
+            )
+        )
+        return (self.f6_mul(a[0], den), self.f6_neg(self.f6_mul(a[1], den)))
+
+    def _f6_sqr_via_mul(self, a):
+        return self.f6_mul(a, a)
+
+    def f12_zero(self, batch):
+        return (self.f6_zero(batch), self.f6_zero(batch))
+
+    def f12_one(self, batch):
+        return (self.f6_one(batch), self.f6_zero(batch))
+
+    def f12_select(self, mask, a, b):
+        return (
+            self.f6_select(mask, a[0], b[0]),
+            self.f6_select(mask, a[1], b[1]),
+        )
+
+    def f12_eq(self, a, b):
+        out = None
+        for x, y in zip(self._flatten12(a), self._flatten12(b)):
+            e = self.F.eq(x, y)
+            out = e if out is None else (out & e)
+        return out
+
+    def _flatten12(self, a):
+        return [a[i][j][k] for i in range(2) for j in range(3) for k in range(2)]
+
+    def f12_frobenius(self, a):
+        """x -> x^p (bn254_ref.f12_frobenius structure: conjugate each Fp2
+        coordinate, multiply w-degree-j slots by gamma_j)."""
+        (c00, c01, c02), (c10, c11, c12) = a
+        batch = c00[0].shape[1]
+
+        def g(j):
+            g0, g1 = self._gamma[j]
+            return (
+                jnp.broadcast_to(g0, (self.F.nlimbs, batch)),
+                jnp.broadcast_to(g1, (self.F.nlimbs, batch)),
+            )
+
+        # stack the 5 gamma multiplications into one f2_mul call
+        lhs = self._f2_stack(
+            [
+                self.f2_conj(c01),
+                self.f2_conj(c02),
+                self.f2_conj(c10),
+                self.f2_conj(c11),
+                self.f2_conj(c12),
+            ]
+        )
+        rhs = self._f2_stack([g(2), g(4), g(1), g(3), g(5)])
+        m01, m02, m10, m11, m12 = self._f2_unstack(self.f2_mul(lhs, rhs), 5)
+        return ((self.f2_conj(c00), m01, m02), (m10, m11, m12))
+
+    def f12_frobenius2(self, a):
+        return self.f12_frobenius(self.f12_frobenius(a))
+
+    def f12_pow_const(self, a, e: int):
+        """a^e for a fixed public exponent via lax.scan (square + selected
+        multiply per bit): keeps the traced graph ~60x smaller than unrolling,
+        which matters for XLA compile times (task spec: compiler-friendly
+        control flow)."""
+        import jax
+
+        bits = jnp.asarray([int(c) for c in bin(e)[2:]], jnp.uint32)
+
+        def step(acc, bit):
+            acc = self.f12_sqr(acc)
+            mult = self.f12_mul(acc, a)
+            acc = self.f12_select(jnp.broadcast_to(bit == 1, acc[0][0][0].shape[1:]), mult, acc)
+            return acc, None
+
+        acc, _ = jax.lax.scan(step, a, bits[1:])
+        return acc
+
+    def f12_pow_u(self, a):
+        """a^U for the BN parameter U."""
+        return self.f12_pow_const(a, bn.U)
+
+    # -- host conversions ---------------------------------------------------
+
+    def f2_pack(self, vals):
+        """List of bn254_ref Fp2 values -> batched limb Fp2."""
+        return (
+            self.F.pack([v[0] for v in vals]),
+            self.F.pack([v[1] for v in vals]),
+        )
+
+    def f2_unpack(self, a):
+        c0 = self.F.unpack(a[0])
+        c1 = self.F.unpack(a[1])
+        return list(zip(c0, c1))
+
+    def f12_pack(self, vals):
+        """List of bn254_ref Fp12 values -> batched limb Fp12."""
+        return tuple(
+            tuple(
+                self.f2_pack([v[i][j] for v in vals]) for j in range(3)
+            )
+            for i in range(2)
+        )
+
+    def f12_unpack(self, a):
+        flat = [self.f2_unpack(a[i][j]) for i in range(2) for j in range(3)]
+        batch = len(flat[0])
+        return [
+            (
+                (flat[0][k], flat[1][k], flat[2][k]),
+                (flat[3][k], flat[4][k], flat[5][k]),
+            )
+            for k in range(batch)
+        ]

@@ -1,0 +1,114 @@
+"""JAX limb field arithmetic vs the Python bigint oracle.
+
+SURVEY.md §7 step 1: property tests of the Montgomery limb kernels against
+ops/bn254_ref.py. Runs on CPU (pure-XLA path); the Pallas TPU path shares the
+same `_mul_cols` body and is exercised by bench.py on hardware.
+"""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from handel_tpu.ops import bn254_ref as bn
+from handel_tpu.ops.fp import Field, LIMB_MASK
+
+rng = random.Random(99)
+
+
+@pytest.fixture(scope="module")
+def F():
+    return Field(bn.P, use_pallas=False)
+
+
+def rand_elems(k):
+    return [rng.randrange(bn.P) for _ in range(k)]
+
+
+B = 8
+
+
+def test_pack_unpack_roundtrip(F):
+    xs = rand_elems(B) + [0, 1, bn.P - 1]
+    assert F.unpack(F.pack(xs)) == xs
+    assert F.unpack(F.pack(xs, mont=False), mont=False) == xs
+
+
+def test_mul(F):
+    xs, ys = rand_elems(B), rand_elems(B)
+    out = jax.jit(F.mul)(F.pack(xs), F.pack(ys))
+    assert F.unpack(out) == [x * y % bn.P for x, y in zip(xs, ys)]
+
+
+def test_mul_edge_cases(F):
+    xs = [0, 1, bn.P - 1, bn.P - 1, 2, (bn.P - 1) // 2]
+    ys = [0, bn.P - 1, bn.P - 1, 1, (bn.P + 1) // 2, 2]
+    out = jax.jit(F.mul)(F.pack(xs), F.pack(ys))
+    assert F.unpack(out) == [x * y % bn.P for x, y in zip(xs, ys)]
+
+
+def test_add_sub_neg(F):
+    xs, ys = rand_elems(B) + [0, bn.P - 1], rand_elems(B) + [0, 1]
+    ax, ay = F.pack(xs), F.pack(ys)
+    assert F.unpack(jax.jit(F.add)(ax, ay)) == [
+        (x + y) % bn.P for x, y in zip(xs, ys)
+    ]
+    assert F.unpack(jax.jit(F.sub)(ax, ay)) == [
+        (x - y) % bn.P for x, y in zip(xs, ys)
+    ]
+    assert F.unpack(jax.jit(F.neg)(ax)) == [(-x) % bn.P for x in xs]
+
+
+def test_mont_conversions(F):
+    xs = rand_elems(B)
+    plain = F.pack(xs, mont=False)
+    m = jax.jit(F.to_mont)(plain)
+    assert F.unpack(m) == xs
+    back = jax.jit(F.from_mont)(m)
+    assert F.unpack(back, mont=False) == xs
+
+
+def test_pow_const_and_inv(F):
+    xs = rand_elems(4)
+    ax = F.pack(xs)
+    out = jax.jit(lambda a: F.pow_const(a, 65537))(ax)
+    assert F.unpack(out) == [pow(x, 65537, bn.P) for x in xs]
+    inv = jax.jit(F.inv)(ax)
+    assert F.unpack(inv) == [pow(x, -1, bn.P) for x in xs]
+
+
+def test_eq_is_zero_select(F):
+    xs = [0, 5, 7, 0]
+    ys = [0, 5, 8, 1]
+    ax, ay = F.pack(xs), F.pack(ys)
+    assert jax.jit(F.eq)(ax, ay).tolist() == [True, True, False, False]
+    assert jax.jit(F.is_zero)(F.pack(xs, mont=False)).tolist() == [
+        True,
+        False,
+        False,
+        True,
+    ]
+    mask = jnp.asarray([True, False, True, False])
+    sel = F.select(mask, ax, ay)
+    assert F.unpack(sel) == [0, 5, 7, 1]
+
+
+def test_random_fuzz_mul(F):
+    # wider fuzz: 64 random products in one batch
+    xs, ys = rand_elems(64), rand_elems(64)
+    out = jax.jit(F.mul)(F.pack(xs), F.pack(ys))
+    assert F.unpack(out) == [x * y % bn.P for x, y in zip(xs, ys)]
+
+
+def test_bls12_381_field_params():
+    # the same engine must serve BLS12-381's 381-bit prime (24 limbs)
+    p381 = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
+    F381 = Field(p381, use_pallas=False)
+    assert F381.nlimbs == 24
+    xs, ys = [rng.randrange(p381) for _ in range(4)], [
+        rng.randrange(p381) for _ in range(4)
+    ]
+    out = jax.jit(F381.mul)(F381.pack(xs), F381.pack(ys))
+    assert F381.unpack(out) == [x * y % p381 for x, y in zip(xs, ys)]

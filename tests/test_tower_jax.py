@@ -1,0 +1,99 @@
+"""JAX tower field ops vs the scalar oracle (bn254_ref)."""
+
+import random
+
+import jax
+import pytest
+
+from handel_tpu.ops import bn254_ref as bn
+from handel_tpu.ops.fp import Field
+from handel_tpu.ops.tower import Tower
+
+rng = random.Random(7)
+B = 4
+
+
+@pytest.fixture(scope="module")
+def T():
+    return Tower(Field(bn.P, use_pallas=False))
+
+
+def rand_f2s(k=B):
+    return [(rng.randrange(bn.P), rng.randrange(bn.P)) for _ in range(k)]
+
+
+def rand_f12s(k=B):
+    return [
+        (
+            (rand_f2s(1)[0], rand_f2s(1)[0], rand_f2s(1)[0]),
+            (rand_f2s(1)[0], rand_f2s(1)[0], rand_f2s(1)[0]),
+        )
+        for _ in range(k)
+    ]
+
+
+def test_f2_mul_sqr_inv(T):
+    xs, ys = rand_f2s(), rand_f2s()
+    ax, ay = T.f2_pack(xs), T.f2_pack(ys)
+    assert T.f2_unpack(jax.jit(T.f2_mul)(ax, ay)) == [
+        bn.f2_mul(x, y) for x, y in zip(xs, ys)
+    ]
+    assert T.f2_unpack(jax.jit(T.f2_sqr)(ax)) == [bn.f2_sqr(x) for x in xs]
+    assert T.f2_unpack(jax.jit(T.f2_inv)(ax)) == [bn.f2_inv(x) for x in xs]
+    assert T.f2_unpack(jax.jit(T.f2_mul_xi)(ax)) == [bn.f2_mul_xi(x) for x in xs]
+
+
+def test_f2_mul_fp(T):
+    xs = rand_f2s()
+    ss = [rng.randrange(bn.P) for _ in range(B)]
+    out = jax.jit(T.f2_mul_fp)(T.f2_pack(xs), T.F.pack(ss))
+    assert T.f2_unpack(out) == [bn.f2_scalar(x, s) for x, s in zip(xs, ss)]
+
+
+def test_f12_mul_matches_oracle(T):
+    xs, ys = rand_f12s(), rand_f12s()
+    ax, ay = T.f12_pack(xs), T.f12_pack(ys)
+    got = T.f12_unpack(jax.jit(T.f12_mul)(ax, ay))
+    want = [bn.f12_mul(x, y) for x, y in zip(xs, ys)]
+    assert got == want
+
+
+def test_f12_inv_conj(T):
+    xs = rand_f12s(2)
+    ax = T.f12_pack(xs)
+    got = T.f12_unpack(jax.jit(T.f12_inv)(ax))
+    assert got == [bn.f12_inv(x) for x in xs]
+    assert T.f12_unpack(T.f12_conj(ax)) == [bn.f12_conj(x) for x in xs]
+
+
+def test_f12_frobenius(T):
+    xs = rand_f12s(2)
+    ax = T.f12_pack(xs)
+    assert T.f12_unpack(jax.jit(T.f12_frobenius)(ax)) == [
+        bn.f12_frobenius(x) for x in xs
+    ]
+    assert T.f12_unpack(jax.jit(T.f12_frobenius2)(ax)) == [
+        bn.f12_frobenius2(x) for x in xs
+    ]
+
+
+@pytest.mark.slow
+def test_f12_pow_u(T):
+    xs = rand_f12s(1)
+    ax = T.f12_pack(xs)
+    got = T.f12_unpack(jax.jit(T.f12_pow_u)(ax))
+    assert got == [bn.f12_pow(x, bn.U) for x in xs]
+
+
+def test_f6_mul_v_and_select(T):
+    import jax.numpy as jnp
+
+    xs = rand_f12s(2)
+    ax = T.f12_pack(xs)
+    mask = jnp.asarray([True, False])
+    sel = T.f12_select(mask, ax, T.f12_one(2))
+    got = T.f12_unpack(sel)
+    assert got[0] == xs[0]
+    assert got[1] == bn.F12_ONE
+    eq = T.f12_eq(ax, ax)
+    assert eq.tolist() == [True, True]
