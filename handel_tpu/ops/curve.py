@@ -1,0 +1,355 @@
+"""Batched JAX elliptic-curve group ops for BN254 G1 (Fp) and G2 (Fp2').
+
+Replaces the reference's point arithmetic (`Combine`'s G1/G2 adds at
+bn256/cf/bn256.go:107,199 and scalar mults at :134,153) with TPU-shaped
+kernels. Design choices, TPU-first:
+
+  * **Complete projective formulas** (Renes–Costello–Batina 2015, Alg. 7 for
+    a = 0): ONE branch-free formula covers generic add, doubling, the
+    identity, and inverse points. No data-dependent control flow inside jit —
+    the whole point-add graph is straight-line VPU code, so it vmaps/scans/
+    reduces freely. (The scalar oracle bn254_ref.pt_add branches four ways;
+    that shape would force `lax.cond` everywhere on device.)
+  * Points are (X, Y, Z) homogeneous projective; infinity = (0, 1, 0).
+  * **Mul stacking**: the 14 field multiplications of one complete add are
+    grouped into 3 stacked `Field.mul` calls (widths 3, 4, 6 and one b3 mul),
+    keeping the Pallas mont-mul lanes full even at small point batches
+    (ops/fp.py "batch stacking beats vmap").
+  * **Tree reduction** for aggregate keys/sigs: `sum_points` folds an
+    n-block batch in ceil(log2 n) complete-add stages — the device-side
+    replacement for the reference's sequential pubkey-aggregation loop
+    (processing.go:355-361).
+
+Correctness oracle: ops/bn254_ref.py (g1_add/g2_add/pt_mul); tests in
+tests/test_curve_jax.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from handel_tpu.ops import bn254_ref as bn
+from handel_tpu.ops.fp import Field
+from handel_tpu.ops.tower import Tower
+
+
+class _FpAdapter:
+    """Base-field element algebra for G1: elements are (nlimbs, B) arrays."""
+
+    def __init__(self, F: Field):
+        self.F = F
+
+    def add(self, a, b):
+        return self.F.add(a, b)
+
+    def sub(self, a, b):
+        return self.F.sub(a, b)
+
+    def neg(self, a):
+        return self.F.neg(a)
+
+    def select(self, mask, a, b):
+        return self.F.select(mask, a, b)
+
+    def zero(self, batch):
+        return jnp.zeros((self.F.nlimbs, batch), jnp.uint32)
+
+    def one(self, batch):
+        return self.F.constant(1, batch)
+
+    def eq(self, a, b):
+        return self.F.eq(a, b)
+
+    def is_zero(self, a):
+        return self.F.is_zero(a)
+
+    def batch(self, a):
+        return a.shape[1]
+
+    def mul_many(self, lhs, rhs):
+        """Stacked multiplication: one mont_mul call for k independent muls."""
+        k = len(lhs)
+        prod = self.F.mul(jnp.concatenate(lhs, axis=1), jnp.concatenate(rhs, axis=1))
+        b = prod.shape[1] // k
+        return [prod[:, i * b : (i + 1) * b] for i in range(k)]
+
+    def mul_b3(self, a):
+        """x * 9 (G1: y^2 = x^3 + 3, so b3 = 3b = 9) — add chain, no mul."""
+        a2 = self.F.add(a, a)
+        a4 = self.F.add(a2, a2)
+        a8 = self.F.add(a4, a4)
+        return self.F.add(a8, a)
+
+    def inv(self, a):
+        return self.F.inv(a)
+
+    def mul(self, a, b):
+        return self.F.mul(a, b)
+
+    def concat(self, elems):
+        return jnp.concatenate(elems, axis=1)
+
+    def split(self, e, k):
+        b = e.shape[1] // k
+        return [e[:, i * b : (i + 1) * b] for i in range(k)]
+
+
+class _Fp2Adapter:
+    """Quadratic-extension algebra for G2': elements are Fp2 pairs."""
+
+    def __init__(self, T: Tower):
+        self.T = T
+        # E' coefficient b' = 3/xi; b3 = 3*b' as a host constant
+        self._b3 = bn.f2_scalar(bn.TWIST_B, 3)
+        self._b3_packed = None
+
+    def add(self, a, b):
+        return self.T.f2_add(a, b)
+
+    def sub(self, a, b):
+        return self.T.f2_sub(a, b)
+
+    def neg(self, a):
+        return self.T.f2_neg(a)
+
+    def select(self, mask, a, b):
+        return self.T.f2_select(mask, a, b)
+
+    def zero(self, batch):
+        return self.T.f2_zero(batch)
+
+    def one(self, batch):
+        return self.T.f2_one(batch)
+
+    def eq(self, a, b):
+        return self.T.f2_eq(a, b)
+
+    def is_zero(self, a):
+        return self.T.f2_is_zero(a)
+
+    def batch(self, a):
+        return a[0].shape[1]
+
+    def mul_many(self, lhs, rhs):
+        out = self.T.f2_mul(self.T._f2_stack(lhs), self.T._f2_stack(rhs))
+        return self.T._f2_unstack(out, len(lhs))
+
+    def mul_b3(self, a):
+        b3 = self.T.f2_constant(self._b3, a[0].shape[1])
+        return self.T.f2_mul(a, b3)
+
+    def inv(self, a):
+        return self.T.f2_inv(a)
+
+    def mul(self, a, b):
+        return self.T.f2_mul(a, b)
+
+    def concat(self, elems):
+        return self.T._f2_stack(elems)
+
+    def split(self, e, k):
+        return self.T._f2_unstack(e, k)
+
+
+class Curve:
+    """Batched short-Weierstrass group (y^2 = x^3 + b, a = 0) over an element
+    algebra. Points are (X, Y, Z) pytrees; identity is (0, 1, 0)."""
+
+    def __init__(self, ops):
+        self.ops = ops
+
+    # -- constructors -------------------------------------------------------
+
+    def infinity(self, batch: int):
+        o = self.ops
+        return (o.zero(batch), o.one(batch), o.zero(batch))
+
+    def from_affine(self, x, y):
+        o = self.ops
+        return (x, y, o.one(o.batch(x)))
+
+    # -- predicates ---------------------------------------------------------
+
+    def is_infinity(self, P):
+        return self.ops.is_zero(P[2])
+
+    def eq(self, P, Q):
+        """Projective equality: X1 Z2 == X2 Z1 and Y1 Z2 == Y2 Z1, with both-
+        infinite handled by the cross products all being zero."""
+        o = self.ops
+        a, b, c, d = o.mul_many([P[0], Q[0], P[1], Q[1]], [Q[2], P[2], Q[2], P[2]])
+        both_inf = self.is_infinity(P) & self.is_infinity(Q)
+        one_inf = self.is_infinity(P) ^ self.is_infinity(Q)
+        return (o.eq(a, b) & o.eq(c, d) & ~one_inf) | both_inf
+
+    # -- group law ----------------------------------------------------------
+
+    def add(self, P, Q):
+        """Complete projective addition (RCB15 Alg. 7, a = 0): 12 muls +
+        2 b3-muls, stacked into 3 wide Field.mul calls. Handles P == Q,
+        P == -Q, and either operand at infinity with the same code path."""
+        o = self.ops
+        X1, Y1, Z1 = P
+        X2, Y2, Z2 = Q
+        a, b, c = o.mul_many([X1, Y1, Z1], [X2, Y2, Z2])
+        d, e, f = o.mul_many(
+            [o.add(X1, Y1), o.add(Y1, Z1), o.add(X1, Z1)],
+            [o.add(X2, Y2), o.add(Y2, Z2), o.add(X2, Z2)],
+        )
+        d = o.sub(d, o.add(a, b))  # X1Y2 + X2Y1
+        e = o.sub(e, o.add(b, c))  # Y1Z2 + Y2Z1
+        f = o.sub(f, o.add(a, c))  # X1Z2 + X2Z1
+        g = o.add(o.add(a, a), a)  # 3 X1X2
+        h = o.mul_b3(c)
+        i = o.add(b, h)
+        j = o.sub(b, h)
+        k = o.mul_b3(f)
+        m0, m1, m2, m3, m4, m5 = o.mul_many([d, e, j, k, g, i], [j, k, i, g, d, e])
+        X3 = o.sub(m0, m1)  # d*j - e*k
+        Y3 = o.add(m2, m3)  # j*i + k*g
+        Z3 = o.add(m5, m4)  # i*e + g*d
+        return (X3, Y3, Z3)
+
+    def double(self, P):
+        return self.add(P, P)
+
+    def neg(self, P):
+        return (P[0], self.ops.neg(P[1]), P[2])
+
+    def select(self, mask, P, Q):
+        o = self.ops
+        return tuple(o.select(mask, p, q) for p, q in zip(P, Q))
+
+    # -- scalar multiplication ----------------------------------------------
+
+    def scalar_mul(self, P, bits):
+        """[k]P with per-lane scalars. bits: (nbits, B) uint32 array, MSB
+        first. Double-and-add under lax.scan with a per-lane select — fixed
+        trip count, no data-dependent control flow."""
+
+        def step(acc, bit):
+            acc = self.double(acc)
+            added = self.add(acc, P)
+            acc = self.select(bit == 1, added, acc)
+            return acc, None
+
+        acc, _ = jax.lax.scan(step, self.infinity(self.ops.batch(P[0])), bits)
+        return acc
+
+    # -- reductions ----------------------------------------------------------
+
+    def sum_points(self, P, n: int):
+        """Tree-sum n point blocks laid out block-major along the batch axis:
+        each coordinate has shape (..., n*b); returns points of batch b.
+
+        This is the aggregation kernel: ceil(log2 n) complete-add stages,
+        each a single stacked launch at half the remaining width — vs the
+        reference's n sequential `Combine` calls (processing.go:355-361)."""
+        o = self.ops
+        b = o.batch(P[0]) // n
+        while n > 1:
+            if n % 2:  # pad with one infinity block
+                inf = self.infinity(b)
+                P = tuple(
+                    o.concat([coord, icoord]) for coord, icoord in zip(P, inf)
+                )
+                n += 1
+            half = n // 2 * b
+            lo = tuple(o.split(coord, 2)[0] for coord in P)
+            hi = tuple(o.split(coord, 2)[1] for coord in P)
+            P = self.add(lo, hi)
+            n //= 2
+        return P
+
+    def masked_sum(self, P, mask, n: int):
+        """Sum of the blocks whose mask bit is set. mask: (n*b,) bool over the
+        block-major batch axis. Unset blocks are replaced by infinity first,
+        then tree-summed — the device form of bitset-selected aggregation."""
+        P = self.select(mask, P, self.infinity(self.ops.batch(P[0])))
+        return self.sum_points(P, n)
+
+    # -- affine conversion (host boundary) -----------------------------------
+
+    def to_affine(self, P):
+        """(x, y, inf_mask): one field inversion per lane. Infinity lanes
+        return (0, 0) with the mask set."""
+        o = self.ops
+        inf = self.is_infinity(P)
+        z = o.select(inf, o.one(o.batch(P[2])), P[2])
+        zinv = o.inv(z)
+        x, y = o.mul_many([P[0], P[1]], [zinv, zinv])
+        zero = o.zero(o.batch(x))
+        return (
+            o.select(inf, zero, x),
+            o.select(inf, zero, y),
+            inf,
+        )
+
+    def on_curve(self, P):
+        """Projective curve membership: Y^2 Z == X^3 + b Z^3 (b3/3 = b).
+        Infinity (0,1,0) satisfies it."""
+        o = self.ops
+        yy, xx, zz = o.mul_many([P[1], P[0], P[2]], [P[1], P[0], P[2]])
+        lhs, x3, z3 = o.mul_many([yy, xx, zz], [P[2], P[0], P[2]])
+        # b*Z^3 = b3*Z^3 / 3: cheaper to compute b3*z3 then... 3 is not
+        # invertible by shifts; instead compute b*Z^3 via b3 chain on a third.
+        # Use: rhs = X^3 + b*Z^3 where b*Z^3 = mul_b3(z3) "minus" 2/3 — avoid
+        # division: compare 3*Y^2 Z == 3*X^3 + b3*Z^3.
+        three = lambda t: o.add(o.add(t, t), t)
+        return o.eq(three(lhs), o.add(three(x3), o.mul_b3(z3)))
+
+
+class BN254Curves:
+    """The two BN254 groups sharing one Field/Tower, plus host conversions."""
+
+    def __init__(self, field: Field | None = None, tower: Tower | None = None):
+        self.F = field or Field(bn.P)
+        self.T = tower or Tower(self.F)
+        self.g1 = Curve(_FpAdapter(self.F))
+        self.g2 = Curve(_Fp2Adapter(self.T))
+
+    # -- host packing: scalar oracle points <-> device batches ---------------
+
+    def pack_g1(self, pts):
+        """List of bn254_ref affine G1 points (or None) -> projective batch."""
+        xs = [0 if p is None else p[0] for p in pts]
+        ys = [1 if p is None else p[1] for p in pts]
+        zs = [0 if p is None else 1 for p in pts]
+        return (self.F.pack(xs), self.F.pack(ys), self.F.pack(zs))
+
+    def unpack_g1(self, P):
+        x, y, inf = self.g1.to_affine(P)
+        xs = self.F.unpack(x)
+        ys = self.F.unpack(y)
+        import numpy as np
+
+        infs = np.asarray(inf)
+        return [None if infs[i] else (xs[i], ys[i]) for i in range(len(xs))]
+
+    def pack_g2(self, pts):
+        f20 = bn.F2_ZERO
+        xs = [f20 if p is None else p[0] for p in pts]
+        ys = [bn.F2_ONE if p is None else p[1] for p in pts]
+        zs = [f20 if p is None else bn.F2_ONE for p in pts]
+        return (self.T.f2_pack(xs), self.T.f2_pack(ys), self.T.f2_pack(zs))
+
+    def unpack_g2(self, P):
+        x, y, inf = self.g2.to_affine(P)
+        xs = self.T.f2_unpack(x)
+        ys = self.T.f2_unpack(y)
+        import numpy as np
+
+        infs = np.asarray(inf)
+        return [None if infs[i] else (xs[i], ys[i]) for i in range(len(xs))]
+
+    @staticmethod
+    def scalar_bits(ks, nbits: int = 256):
+        """Host: list of ints -> (nbits, len(ks)) uint32 MSB-first bit array."""
+        import numpy as np
+
+        out = np.zeros((nbits, len(ks)), np.uint32)
+        for j, k in enumerate(ks):
+            for i in range(nbits):
+                out[nbits - 1 - i, j] = (k >> i) & 1
+        return jnp.asarray(out)

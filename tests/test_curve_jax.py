@@ -1,0 +1,129 @@
+"""JAX curve ops vs the scalar oracle (tests mirror the role of the
+reference's bn256 sign/combine unit tests, bn256/*/bn256_test.go:39-99)."""
+
+import random
+
+import numpy as np
+import pytest
+
+from handel_tpu.ops import bn254_ref as bn
+from handel_tpu.ops.curve import BN254Curves
+
+random.seed(0xC04FE)
+
+
+@pytest.fixture(scope="module")
+def curves():
+    return BN254Curves()
+
+
+def _rand_g1(k=None):
+    k = k if k is not None else random.randrange(1, bn.R)
+    return bn.g1_mul(bn.G1_GEN, k)
+
+
+def _rand_g2(k=None):
+    k = k if k is not None else random.randrange(1, bn.R)
+    return bn.g2_mul(bn.G2_GEN, k)
+
+
+def test_g1_add_batch(curves):
+    B = 8
+    ps = [_rand_g1() for _ in range(B)]
+    qs = [_rand_g1() for _ in range(B)]
+    # exercise the complete-formula corner cases in-lane
+    qs[0] = ps[0]  # doubling
+    qs[1] = bn.g1_neg(ps[1])  # inverse -> infinity
+    ps[2] = None  # left identity
+    qs[3] = None  # right identity
+    out = curves.g1.add(curves.pack_g1(ps), curves.pack_g1(qs))
+    got = curves.unpack_g1(out)
+    want = [bn.g1_add(p, q) for p, q in zip(ps, qs)]
+    assert got == want
+
+
+def test_g2_add_batch(curves):
+    B = 6
+    ps = [_rand_g2() for _ in range(B)]
+    qs = [_rand_g2() for _ in range(B)]
+    qs[0] = ps[0]
+    qs[1] = bn.g2_neg(ps[1])
+    ps[2] = None
+    out = curves.g2.add(curves.pack_g2(ps), curves.pack_g2(qs))
+    got = curves.unpack_g2(out)
+    want = [bn.g2_add(p, q) for p, q in zip(ps, qs)]
+    assert got == want
+
+
+def test_g1_scalar_mul(curves):
+    ks = [1, 2, 3, random.randrange(bn.R), bn.R - 1, 0, 7, 1 << 200]
+    P = curves.pack_g1([bn.G1_GEN] * len(ks))
+    bits = curves.scalar_bits(ks)
+    got = curves.unpack_g1(curves.g1.scalar_mul(P, bits))
+    want = [bn.g1_mul(bn.G1_GEN, k) for k in ks]
+    assert got == want
+
+
+def test_g2_scalar_mul(curves):
+    ks = [1, 5, random.randrange(bn.R), 0]
+    P = curves.pack_g2([bn.G2_GEN] * len(ks))
+    bits = curves.scalar_bits(ks)
+    got = curves.unpack_g2(curves.g2.scalar_mul(P, bits))
+    want = [bn.g2_mul(bn.G2_GEN, k) for k in ks]
+    assert got == want
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 7, 16])
+def test_g1_sum_points(curves, n):
+    b = 4
+    pts = [[_rand_g1() for _ in range(b)] for _ in range(n)]
+    pts[0][0] = None  # infinity inside the tree
+    flat = [p for block in pts for p in block]
+    P = curves.pack_g1(flat)
+    got = curves.unpack_g1(curves.g1.sum_points(P, n))
+    want = []
+    for j in range(b):
+        acc = None
+        for i in range(n):
+            acc = bn.g1_add(acc, pts[i][j])
+        want.append(acc)
+    assert got == want
+
+
+def test_g2_masked_sum(curves):
+    n, b = 8, 2
+    pts = [[_rand_g2() for _ in range(b)] for _ in range(n)]
+    mask = np.array([bool(random.getrandbits(1)) for _ in range(n * b)])
+    flat = [p for block in pts for p in block]
+    P = curves.pack_g2(flat)
+    import jax.numpy as jnp
+
+    got = curves.unpack_g2(curves.g2.masked_sum(P, jnp.asarray(mask), n))
+    want = []
+    for j in range(b):
+        acc = None
+        for i in range(n):
+            if mask[i * b + j]:
+                acc = bn.g2_add(acc, pts[i][j])
+        want.append(acc)
+    assert got == want
+
+
+def test_eq_and_on_curve(curves):
+    ps = [_rand_g1() for _ in range(4)] + [None]
+    P = curves.pack_g1(ps)
+    # P == P (incl. infinity lane)
+    assert bool(np.asarray(curves.g1.eq(P, P)).all())
+    # scaled projective coordinates still equal
+    two = curves.F.constant(2, len(ps))
+    P2 = tuple(curves.F.mul(c, two) for c in P)
+    assert bool(np.asarray(curves.g1.eq(P, P2)).all())
+    assert bool(np.asarray(curves.g1.on_curve(P)).all())
+    bad = (P[1], P[0], P[2])  # swap x/y: not on curve (generic points)
+    assert not np.asarray(curves.g1.on_curve(bad))[:4].any()
+
+
+def test_g2_on_curve(curves):
+    qs = [_rand_g2() for _ in range(3)] + [None]
+    Q = curves.pack_g2(qs)
+    assert bool(np.asarray(curves.g2.on_curve(Q)).all())
