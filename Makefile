@@ -1,0 +1,26 @@
+# Test tiers (CI mirror; reference CI = `go test -v ./...`,
+# .circleci/config.yml:26-28 — here split so the fast tier stays minutes-fast
+# on one core even with a cold XLA compile cache).
+
+PY ?= python
+
+.PHONY: test test-fast test-slow test-all bench dryrun
+
+# fast tier: protocol + transports + sim harness + cached JAX kernel tests
+test-fast:
+	$(PY) -m pytest tests/ -x -q
+
+# reference-scale tier: 333-node failures, 37-node real crypto, BLS12-381 e2e
+test-slow:
+	$(PY) -m pytest tests/ -x -q -m slow
+
+test-all:
+	$(PY) -m pytest tests/ -x -q -m ""
+
+test: test-fast
+
+bench:
+	$(PY) bench.py
+
+dryrun:
+	GRAFT_DRYRUN_DEVICES=8 $(PY) __graft_entry__.py

@@ -1,0 +1,15 @@
+"""Baseline comparison protocols.
+
+Reference: simul/p2p/ — a gossip aggregator (aggregator.go:17-276) over two
+transports: full-mesh "N^2" UDP diffusion (p2p/udp/node.go:17-91) and libp2p
+gossipsub (p2p/libp2p/node.go:89-434). These exist only to produce the
+comparison curves against Handel (BASELINE.md rows "Baseline N^2 gossip" and
+"Baseline libp2p"). Here the gossip aggregator runs over the same Network
+interface as the protocol (in-process router or UDP sockets); a gossipsub
+mesh would need an external dependency and is represented by the
+random-subset connector instead.
+"""
+
+from handel_tpu.baselines.gossip import GossipAggregator, run_gossip
+
+__all__ = ["GossipAggregator", "run_gossip"]

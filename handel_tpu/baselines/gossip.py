@@ -1,0 +1,196 @@
+"""Gossip ("diffuse-everything") aggregation baseline.
+
+Reference: simul/p2p/aggregator.go:17-276 — every node periodically diffuses
+individual signatures it knows; aggregation happens locally once `threshold`
+distinct signatures are collected. Two verification modes mirror the
+reference: `verify_incoming=True` checks every individual signature as it
+arrives (aggregator.go verifyPacket); False defers verification to the final
+aggregate (aggregate-then-verify, aggregator.go:206 mode). Connectors:
+`full` = diffuse to the entire registry ("N^2", p2p/udp/node.go Diffuse) or
+`random-k` = k random peers per round (the gossipsub stand-in).
+
+Packet reuse: gossip rides the same `Packet` wire format with level=255 as
+the baseline marker (the reference uses a dedicated setup level 255 in
+p2p/libp2p/node.go).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from typing import Sequence
+
+from handel_tpu.core.crypto import Constructor, MultiSignature
+from handel_tpu.core.bitset import BitSet
+from handel_tpu.core.identity import Identity, Registry
+from handel_tpu.core.net import Network, Packet
+
+GOSSIP_LEVEL = 255
+
+
+class GossipAggregator:
+    """One gossip node (aggregator.go Aggregator)."""
+
+    def __init__(
+        self,
+        network: Network,
+        registry: Registry,
+        identity: Identity,
+        constructor: Constructor,
+        msg: bytes,
+        own_sig,
+        threshold: int,
+        *,
+        period: float = 0.05,
+        connector: str = "full",
+        fanout: int = 8,
+        verify_incoming: bool = True,
+        rand: random.Random | None = None,
+    ):
+        self.net = network
+        self.reg = registry
+        self.id = identity.id
+        self.cons = constructor
+        self.msg = msg
+        self.threshold = threshold
+        self.period = period
+        self.connector = connector
+        self.fanout = fanout
+        self.verify_incoming = verify_incoming
+        self.rand = rand or random.Random(identity.id)
+        # known individual signatures by origin (aggregator.go sigs map)
+        self.sigs: dict[int, object] = {identity.id: own_sig}
+        self.final: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._task: asyncio.Task | None = None
+        self.sigs_checked = 0
+        network.register_listener(self)
+
+    # -- network in ---------------------------------------------------------
+
+    def new_packet(self, packet: Packet) -> None:
+        if packet.level != GOSSIP_LEVEL or packet.origin == self.id:
+            return
+        if packet.origin in self.sigs:
+            return
+        try:
+            sig = self.cons.unmarshal_signature(packet.multisig)
+        except Exception:
+            return
+        if self.verify_incoming:
+            pk = self.reg.identity(packet.origin).public_key
+            self.sigs_checked += 1
+            if not pk.verify(self.msg, sig):
+                return
+        self.sigs[packet.origin] = sig
+        self._maybe_finish()
+
+    def _maybe_finish(self) -> None:
+        if self.final.done() or len(self.sigs) < self.threshold:
+            return
+        bs = BitSet(self.reg.size())
+        agg = None
+        for origin, sig in self.sigs.items():
+            bs.set(origin, True)
+            agg = sig if agg is None else agg.combine(sig)
+        ms = MultiSignature(bs, agg)
+        if not self.verify_incoming:
+            # aggregate-then-verify mode: one check at threshold
+            keys = [
+                self.reg.identity(i).public_key for i in range(self.reg.size())
+            ]
+            self.sigs_checked += 1
+            if not self.cons.aggregate_public_keys(keys, bs).verify(
+                self.msg, agg
+            ):
+                return  # poisoned set; keep gossiping (binary search is the
+                # reference's TODO at aggregator.go:206 — same behavior)
+        self.final.set_result(ms)
+
+    # -- gossip loop --------------------------------------------------------
+
+    def start(self) -> None:
+        self._task = asyncio.get_event_loop().create_task(self._loop())
+
+    def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+
+    def _peers(self) -> Sequence[Identity]:
+        ids = [
+            self.reg.identity(i)
+            for i in range(self.reg.size())
+            if i != self.id
+        ]
+        if self.connector == "full":
+            return ids
+        return self.rand.sample(ids, min(self.fanout, len(ids)))
+
+    async def _loop(self) -> None:
+        while not self.final.done():
+            # diffuse every known individual signature (aggregator.go Diffuse)
+            for origin, sig in list(self.sigs.items()):
+                self.net.send(
+                    self._peers(),
+                    Packet(
+                        origin=origin,
+                        level=GOSSIP_LEVEL,
+                        multisig=sig.marshal(),
+                    ),
+                )
+            self._maybe_finish()
+            await asyncio.sleep(self.period)
+
+    def values(self) -> dict[str, float]:
+        return {
+            "sigsKnown": float(len(self.sigs)),
+            "sigCheckedCt": float(self.sigs_checked),
+        }
+
+
+async def run_gossip(
+    n: int,
+    threshold: int | None = None,
+    timeout: float = 20.0,
+    scheme=None,
+    **kwargs,
+) -> dict[int, MultiSignature]:
+    """Run an n-node gossip aggregation over the in-process router."""
+    from handel_tpu.core.test_harness import FakeScheme, InProcessNetwork, InProcessRouter
+
+    scheme = scheme or FakeScheme()
+    threshold = threshold or (n // 2 + 1)
+    router = InProcessRouter()
+    idents, secrets = [], []
+    for i in range(n):
+        sk, pk = scheme.keygen(i)
+        idents.append(Identity(i, f"gossip-{i}", pk))
+        secrets.append(sk)
+    from handel_tpu.core.identity import ArrayRegistry
+
+    registry = ArrayRegistry(idents)
+    msg = b"gossip baseline msg"
+    nodes = []
+    for i in range(n):
+        net = InProcessNetwork(router, f"gossip-{i}")
+        nodes.append(
+            GossipAggregator(
+                net,
+                registry,
+                idents[i],
+                scheme.constructor,
+                msg,
+                secrets[i].sign(msg),
+                threshold,
+                **kwargs,
+            )
+        )
+    for node in nodes:
+        node.start()
+    try:
+        finals = await asyncio.wait_for(
+            asyncio.gather(*(node.final for node in nodes)), timeout
+        )
+    finally:
+        for node in nodes:
+            node.stop()
+    return dict(zip(range(n), finals))

@@ -42,6 +42,7 @@ class IncomingSig:
     ms: MultiSignature | None
     is_ind: bool = False
     mapped_index: int = 0
+    verify_tries: int = 0  # verifier-error retry count (processing requeue)
 
     @property
     def individual(self) -> bool:

@@ -111,6 +111,7 @@ class BatchProcessing:
         self.unsafe_sleep_ms = unsafe_sleep_ms
         self.log = logger
         self.filter: Filter = IndividualSigFilter()
+        self.max_retries = 3  # per-candidate verifier-error retry budget
 
         self._todos: list[IncomingSig] = []
         self._wakeup = asyncio.Event()
@@ -191,15 +192,22 @@ class BatchProcessing:
                     (self._global_bitset(sp), sp.ms.signature) for sp in batch
                 ]
                 oks = await self.verifier(self.msg, self.pubkeys, requests)
-            except Exception as e:  # a verify error is per-batch, never fatal
-                # (reference treats it per-signature: processing.go:282-284)
+            except Exception as e:
+                # A transient verifier error (device hiccup, RPC failure) must
+                # not silently discard candidates: requeue the batch with a
+                # per-candidate retry cap so the evaluator re-scores it on the
+                # next step. (The reference logs per-signature errors and moves
+                # on, processing.go:282-284; the protocol's periodic resend is
+                # not guaranteed for individual sigs, hence the requeue.)
                 self.log.warn("verifier_error", e)
+                self._requeue(batch)
                 return
             if len(oks) != len(batch):
                 self.log.error(
                     "verifier_contract",
                     f"{len(oks)} verdicts for {len(batch)} requests",
                 )
+                self._requeue(batch)
                 return
         self.sig_checking_time_ms += (time.perf_counter() - start) * 1000.0
 
@@ -210,6 +218,22 @@ class BatchProcessing:
                 self.log.warn(
                     "verify_failed", f"origin={sp.origin} level={sp.level}"
                 )
+
+    def _requeue(self, batch: list[IncomingSig]) -> None:
+        """Put errored candidates back on the todo queue, up to max_retries
+        attempts each; drop (with a log line) beyond that."""
+        for sp in batch:
+            sp.verify_tries += 1
+            tries = sp.verify_tries
+            if tries <= self.max_retries:
+                self._todos.append(sp)
+            else:
+                self.log.error(
+                    "verify_retries_exhausted",
+                    f"origin={sp.origin} level={sp.level} tries={tries}",
+                )
+        if self._todos:
+            self._wakeup.set()
 
     def _global_bitset(self, sp: IncomingSig) -> BitSet:
         """Shift a level-local bitset to registry coordinates

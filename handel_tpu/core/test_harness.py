@@ -87,12 +87,15 @@ class LocalCluster:
         msg: bytes = b"hello world",
         config_factory: Callable[[int], Config] | None = None,
         seed: int = 1,
+        loss_rate: float = 0.0,
     ):
         self.n = n
         self.scheme = scheme or FakeScheme()
         self.msg = msg
         self.offline = set(offline)
-        self.router = InProcessRouter()
+        self.router = InProcessRouter(
+            loss_rate=loss_rate, rand=random.Random(seed)
+        )
         cons: Constructor = self.scheme.constructor
 
         secrets, idents = [], []

@@ -191,10 +191,17 @@ class BN254Constructor(Constructor):
 
 
 class BN254Scheme:
-    """Keygen facade for the test harness / simulation keygen."""
+    """Keygen facade for the test harness / simulation keygen, with the
+    marshalable-secret extension of simul/lib/crypto.go:18-169."""
 
     def __init__(self):
         self.constructor = BN254Constructor()
 
     def keygen(self, i: int):
         return new_keypair(seed=i)
+
+    def unmarshal_public(self, data: bytes) -> BN254PublicKey:
+        return BN254PublicKey(unmarshal_g2(data))
+
+    def unmarshal_secret(self, data: bytes) -> BN254SecretKey:
+        return BN254SecretKey.unmarshal(data)

@@ -1,0 +1,237 @@
+"""BLS-over-BN254 with verification on the JAX/TPU path.
+
+This is the device Constructor the project exists for: it replaces the serial
+verify loop of the reference (`verifySignature`, processing.go:342-368 —
+aggregate-pubkey loop + `bn256.Pair` at bn256/cf/bn256.go:86-98) with ONE
+batched launch per candidate batch:
+
+  1. aggregate public keys = masked G2 tree-sum over the device-resident
+     registry array (ops/curve.py `masked_sum`; the reference's per-signature
+     Combine loop at processing.go:355-361),
+  2. batched product-of-pairings check
+     e(H(m), X_j) * e(-S_j, B2) == 1  for every candidate j
+     with one shared final exponentiation (ops/pairing.py `pairing_check`;
+     the reference's per-signature two-pairing compare, bn256/go/bn256.go:82-94).
+
+Keys/signatures/wire formats are the host objects from models/bn254.py
+(cloudflare-compatible marshal); only verification moves on device. Candidate
+batches are padded to a fixed `batch_size` so the jit executable is reused
+across calls.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from handel_tpu.core.bitset import BitSet
+from handel_tpu.models.bn254 import (
+    BN254Constructor,
+    BN254PublicKey,
+    BN254Signature,
+    hash_to_g1,
+    new_keypair,
+)
+from handel_tpu.ops import bn254_ref as bn
+from handel_tpu.ops.curve import BN254Curves
+from handel_tpu.ops.pairing import BN254Pairing
+
+
+class BN254Device:
+    """Device-side verification engine bound to one registry.
+
+    Holds the registry's public keys as dense (nlimbs, N) G2 coordinate
+    arrays uploaded once (SURVEY.md §2.1 identity row: "registry pubkeys
+    additionally uploaded once to device memory as a dense G2 array").
+    """
+
+    def __init__(
+        self,
+        registry_pubkeys: Sequence[BN254PublicKey],
+        batch_size: int = 16,
+        curves: BN254Curves | None = None,
+    ):
+        self.curves = curves or BN254Curves()
+        self.pairing = BN254Pairing(self.curves)
+        self.batch_size = batch_size
+        self.n = len(registry_pubkeys)
+        T = self.curves.T
+        pts = [pk.point for pk in registry_pubkeys]
+        if any(p is None for p in pts):
+            raise ValueError("registry public keys must be valid G2 points")
+        self._reg_x = T.f2_pack([p[0] for p in pts])  # ((L, N), (L, N))
+        self._reg_y = T.f2_pack([p[1] for p in pts])
+        self._h_cache: dict[bytes, tuple] = {}
+        self._kernel = jax.jit(self._verify_batch)
+
+    # -- the jitted batch kernel -------------------------------------------
+
+    def _verify_batch(self, reg_x, reg_y, mask, sig_x, sig_y, h_x, h_y, valid):
+        """One launch: masked G2 segment-sum + batched multi-pairing.
+
+        Shapes: reg_* (L, N) Fp2 pairs; mask (N*C,) bool block-major
+        (block i = registry key i across C candidates); sig_*/h_* (L, C);
+        valid (C,) bool. Returns (C,) verdicts.
+        """
+        C = self.batch_size
+        g2 = self.curves.g2
+        g1c = self.curves.g1
+        T = self.curves.T
+        F = self.curves.F
+
+        # registry tiled block-major across candidates, masked, tree-summed
+        tile = lambda a: jnp.repeat(a, C, axis=1)  # (L, N) -> (L, N*C)
+        P2 = g2.from_affine(
+            (tile(reg_x[0]), tile(reg_x[1])), (tile(reg_y[0]), tile(reg_y[1]))
+        )
+        agg = g2.masked_sum(P2, mask, self.n)  # projective, batch C
+        agg_inf = g2.is_infinity(agg)
+        qx, qy, _ = g2.to_affine(agg)
+
+        # pairs chunk-major: [e(H, X_j)] ++ [e(-S_j, B2)]
+        b2 = self.curves.T.f2_pack([bn.G2_GEN[0]] * 1), self.curves.T.f2_pack(
+            [bn.G2_GEN[1]] * 1
+        )
+        bx = (
+            jnp.broadcast_to(b2[0][0], qx[0].shape),
+            jnp.broadcast_to(b2[0][1], qx[0].shape),
+        )
+        by = (
+            jnp.broadcast_to(b2[1][0], qy[0].shape),
+            jnp.broadcast_to(b2[1][1], qy[0].shape),
+        )
+        neg_sig_y = F.neg(sig_y)
+        px = jnp.concatenate([jnp.broadcast_to(h_x, sig_x.shape), sig_x], axis=1)
+        py = jnp.concatenate([jnp.broadcast_to(h_y, sig_y.shape), neg_sig_y], axis=1)
+        qx2 = (
+            jnp.concatenate([qx[0], bx[0]], axis=1),
+            jnp.concatenate([qx[1], bx[1]], axis=1),
+        )
+        qy2 = (
+            jnp.concatenate([qy[0], by[0]], axis=1),
+            jnp.concatenate([qy[1], by[1]], axis=1),
+        )
+        ok_lane = valid & ~agg_inf
+        lane_mask = jnp.concatenate([ok_lane, ok_lane])
+        checks = self.pairing.pairing_check((px, py), (qx2, qy2), lane_mask, C)
+        return checks & ok_lane
+
+    # -- host entry points --------------------------------------------------
+
+    def _h_point(self, msg: bytes):
+        cached = self._h_cache.get(msg)
+        if cached is None:
+            h = hash_to_g1(msg)
+            cached = (
+                self.curves.F.pack([h[0]]),
+                self.curves.F.pack([h[1]]),
+            )
+            self._h_cache[msg] = cached
+        return cached
+
+    def batch_verify(
+        self,
+        msg: bytes,
+        requests: Sequence[tuple[BitSet, BN254Signature]],
+    ) -> list[bool]:
+        """Verify up to batch_size (global bitset, aggregate sig) candidates
+        in one device launch; longer request lists run in several launches."""
+        out: list[bool] = []
+        for i in range(0, len(requests), self.batch_size):
+            out.extend(self._one_launch(msg, requests[i : i + self.batch_size]))
+        return out
+
+    def _one_launch(self, msg, requests) -> list[bool]:
+        C = self.batch_size
+        F = self.curves.F
+        mask = np.zeros((self.n, C), dtype=bool)
+        sig_pts = []
+        valid = np.zeros((C,), dtype=bool)
+        for j, (bs, sig) in enumerate(requests):
+            if len(bs) != self.n:
+                raise ValueError("bitset length != registry size")
+            idx = list(bs.indices())
+            sig_pt = getattr(sig, "point", None)
+            if idx and sig_pt is not None:
+                mask[idx, j] = True
+                valid[j] = True
+                sig_pts.append(sig_pt)
+            else:
+                sig_pts.append(bn.G1_GEN)  # placeholder, lane masked out
+        sig_pts += [bn.G1_GEN] * (C - len(sig_pts))  # pad lanes
+        sig_x = F.pack([p[0] for p in sig_pts])
+        sig_y = F.pack([p[1] for p in sig_pts])
+        h_x, h_y = self._h_point(msg)
+        verdicts = self._kernel(
+            self._reg_x,
+            self._reg_y,
+            jnp.asarray(mask.reshape(-1)),
+            sig_x,
+            sig_y,
+            h_x,
+            h_y,
+            jnp.asarray(valid),
+        )
+        return [bool(v) for v in np.asarray(verdicts)[: len(requests)]]
+
+
+class BN254JaxConstructor(BN254Constructor):
+    """Constructor whose `batch_verify` runs on the JAX/TPU path.
+
+    The device registry is built lazily from the pubkey sequence of the first
+    call (Handel passes the same registry list every time) or eagerly via
+    `prepare()`. Marshal/unmarshal and single-sig verify stay host-side.
+    """
+
+    def __init__(self, batch_size: int = 16, curves: BN254Curves | None = None):
+        self.batch_size = batch_size
+        self.curves = curves or BN254Curves()
+        self._device: BN254Device | None = None
+        self._device_for: int | None = None
+
+    def prepare(self, pubkeys: Sequence[BN254PublicKey]) -> BN254Device:
+        self._device = BN254Device(
+            pubkeys, batch_size=self.batch_size, curves=self.curves
+        )
+        self._device_for = id(pubkeys)
+        return self._device
+
+    def _device_of(self, pubkeys) -> BN254Device:
+        if self._device is None or (
+            self._device_for is not None
+            and self._device_for != id(pubkeys)
+            and self._device.n != len(pubkeys)
+        ):
+            self.prepare(pubkeys)
+        return self._device
+
+    def batch_verify(self, msg, pubkeys, requests) -> list[bool]:
+        return self._device_of(pubkeys).batch_verify(msg, requests)
+
+
+class BN254JaxScheme:
+    """Keygen facade for harness/simulation use (host keygen, device verify)."""
+
+    def __init__(self, batch_size: int = 16):
+        self.constructor = BN254JaxConstructor(batch_size=batch_size)
+
+    def keygen(self, i: int):
+        return new_keypair(seed=i)
+
+
+def make_async_verifier(device: BN254Device):
+    """Adapt a BN254Device into the processing pipeline's AsyncVerifier,
+    running launches in a worker thread so the event loop stays live."""
+
+    async def verify(msg, pubkeys, requests):
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            None, partial(device.batch_verify, msg, requests)
+        )
+
+    return verify

@@ -54,6 +54,9 @@ class FakeSecret:
     def sign(self, msg: bytes) -> FakeSignature:
         return FakeSignature(True)
 
+    def marshal(self) -> bytes:
+        return struct.pack(">Q", self.id)
+
 
 class FakeConstructor(Constructor):
     def unmarshal_signature(self, data: bytes) -> FakeSignature:
@@ -69,3 +72,22 @@ def fake_registry(n: int) -> ArrayRegistry:
     return ArrayRegistry(
         [Identity(i, f"fake-{i}", FakePublic(True)) for i in range(n)]
     )
+
+
+class FakeScheme:
+    """Scheme facade with simulation marshal support (simul/lib/crypto.go's
+    empty/fake constructors for network-only tests)."""
+
+    def __init__(self):
+        self.constructor = FakeConstructor()
+
+    def keygen(self, i: int):
+        return FakeSecret(i), FakePublic(True)
+
+    def unmarshal_public(self, data: bytes) -> FakePublic:
+        (v,) = struct.unpack(">Q", data[:8])
+        return FakePublic(v == 1)
+
+    def unmarshal_secret(self, data: bytes) -> FakeSecret:
+        (i,) = struct.unpack(">Q", data[:8])
+        return FakeSecret(i)

@@ -1,0 +1,32 @@
+"""String -> signature-scheme dispatch.
+
+Reference: simul/lib/config.go:211-225 (`Config.NewConstructor`: "bn256",
+"bn256/cf", "bn256/go"). Here the names select both keygen and the verify
+path; "bn254-jax" is the device-verification scheme.
+"""
+
+from __future__ import annotations
+
+
+def new_scheme(name: str, **kwargs):
+    name = name.lower()
+    if name in ("fake", "empty"):
+        from handel_tpu.models.fake import FakeScheme
+
+        return FakeScheme()
+    if name in ("bn254", "bn256", "bn254-ref"):
+        from handel_tpu.models.bn254 import BN254Scheme
+
+        return BN254Scheme()
+    if name in ("bn254-jax", "bn254-tpu", "bn256-tpu"):
+        from handel_tpu.models.bn254_jax import BN254JaxScheme
+
+        return BN254JaxScheme(**kwargs)
+    if name in ("bls12-381", "bls12381"):
+        from handel_tpu.models.bls12_381 import BLS12381Scheme
+
+        return BLS12381Scheme()
+    raise ValueError(f"unknown signature scheme: {name!r}")
+
+
+SCHEMES = ("fake", "bn254", "bn254-jax", "bls12-381")

@@ -1,0 +1,28 @@
+"""Real transports for the protocol plane.
+
+Reference: network/ — the `Encoding` wire abstraction
+(network/wireencoding.go:10-13), the byte-counting decorator
+(network/counter_encoding.go:13-63), and the UDP (network/udp/net.go:19-226)
+and TCP (network/tcp/net.go:16-127) transports.
+
+The in-process transport for pod-local simulation lives in
+core/test_harness.py; these sockets carry protocol traffic between hosts
+(DCN). Signature batches ride the separate host<->device plane
+(parallel/batch_verifier.py), never these sockets.
+"""
+
+from handel_tpu.network.encoding import (
+    BinaryEncoding,
+    CounterEncoding,
+    Encoding,
+)
+from handel_tpu.network.udp import UDPNetwork
+from handel_tpu.network.tcp import TCPNetwork
+
+__all__ = [
+    "Encoding",
+    "BinaryEncoding",
+    "CounterEncoding",
+    "UDPNetwork",
+    "TCPNetwork",
+]

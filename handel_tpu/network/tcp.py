@@ -1,0 +1,114 @@
+"""TCP transport with connection caching.
+
+Reference: network/tcp/net.go:16-127 — a listener accepting length-delimited
+packet streams, lazy dial-on-send with a per-peer connection cache, and a
+1-minute idle deadline.
+
+asyncio redesign: an asyncio.Server per node; outbound writers are cached per
+peer address and dropped on error (next send re-dials). Packets on the stream
+are length-prefixed (uint32) since TCP has no message boundaries.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import Sequence
+
+from handel_tpu.core.logging import DEFAULT_LOGGER, Logger
+from handel_tpu.core.net import Listener, Packet
+from handel_tpu.network.encoding import Encoding, BinaryEncoding
+from handel_tpu.network.udp import split_addr
+
+_LEN = struct.Struct(">I")
+IDLE_TIMEOUT = 60.0  # reference's 1-minute conn deadline (tcp/net.go:100)
+
+
+class TCPNetwork:
+    """Stream-based Network with cached outbound connections."""
+
+    def __init__(
+        self,
+        listen_addr: str,
+        encoding: Encoding | None = None,
+        logger: Logger = DEFAULT_LOGGER,
+    ):
+        self.listen_addr = listen_addr
+        self.enc = encoding or BinaryEncoding()
+        self.log = logger
+        self.listeners: list[Listener] = []
+        self._server: asyncio.Server | None = None
+        self._writers: dict[str, asyncio.StreamWriter] = {}
+        self.sent = 0
+        self.rcvd = 0
+
+    async def start(self) -> None:
+        host, port = split_addr(self.listen_addr)
+        self._server = await asyncio.start_server(
+            self._handle_conn, "0.0.0.0", port
+        )
+
+    def stop(self) -> None:
+        if self._server:
+            self._server.close()
+        for w in self._writers.values():
+            w.close()
+        self._writers.clear()
+
+    async def _handle_conn(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        try:
+            while True:
+                hdr = await asyncio.wait_for(
+                    reader.readexactly(_LEN.size), IDLE_TIMEOUT
+                )
+                (size,) = _LEN.unpack(hdr)
+                data = await reader.readexactly(size)
+                try:
+                    packet = self.enc.decode(data)
+                except Exception as e:
+                    self.log.warn("tcp_decode", e)
+                    continue
+                self.rcvd += 1
+                for lst in self.listeners:
+                    lst.new_packet(packet)
+        except (asyncio.IncompleteReadError, asyncio.TimeoutError, OSError):
+            pass
+        finally:
+            writer.close()
+
+    def send(self, identities: Sequence["Identity"], packet: Packet) -> None:  # noqa: F821
+        wire = self.enc.encode(packet)
+        framed = _LEN.pack(len(wire)) + wire
+        for ident in identities:
+            asyncio.get_running_loop().create_task(
+                self._send_to(ident.address, framed)
+            )
+
+    async def _send_to(self, addr: str, framed: bytes) -> None:
+        writer = self._writers.get(addr)
+        if writer is None or writer.is_closing():
+            host, port = split_addr(addr)
+            try:
+                _, writer = await asyncio.open_connection(host, port)
+            except OSError as e:
+                self.log.warn("tcp_dial", f"{addr}: {e}")
+                return
+            self._writers[addr] = writer
+        try:
+            writer.write(framed)
+            await writer.drain()
+            self.sent += 1
+        except OSError as e:
+            self.log.warn("tcp_send", f"{addr}: {e}")
+            self._writers.pop(addr, None)
+
+    def register_listener(self, listener: Listener) -> None:
+        self.listeners.append(listener)
+
+    def values(self) -> dict[str, float]:
+        out = {"sentPackets": float(self.sent), "rcvdPackets": float(self.rcvd)}
+        if hasattr(self.enc, "values"):
+            out.update(self.enc.values())
+        return out

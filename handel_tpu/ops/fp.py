@@ -209,24 +209,135 @@ class Field:
             carry = t >> LIMB_BITS
         return jnp.stack(out)
 
+    # -- carry-lookahead machinery (bit-packed, fully fusable) --------------
+    #
+    # The per-limb Python loops above (_add_rows/_cond_sub_p_rows) trace to
+    # ~150 primitive ops per field add; a pairing contains tens of thousands
+    # of adds, which made XLA tracing/compilation minutes-slow. Shift-based
+    # Kogge-Stone was no better: every `pad` becomes its own unfused LLVM
+    # kernel on the XLA CPU backend. Instead, per-limb generate/propagate
+    # bits are PACKED into one uint32 word per lane and the carry closure is
+    # computed with the classic adder identity
+    #
+    #     carries(A + B) = A ^ B ^ (A + B)   (carry INTO bit i)
+    #
+    # applied to A = g, B = g|p: maj(g, g|p, c) = g | (p & c), exactly the
+    # carry recurrence. ~10 elementwise/reduction ops per add, no data
+    # movement, fuses into one kernel on every backend. Requires nlimbs < 32.
+    # The unrolled per-limb forms are kept for the Pallas kernel body, where
+    # Mosaic wants straight-line register code.
+
+    @property
+    def _bit_weights(self):
+        # plain numpy so it embeds as a fresh constant in every trace
+        w = getattr(self, "_bw", None)
+        if w is None:
+            w = (np.uint64(1) << np.arange(self.nlimbs, dtype=np.uint64)).astype(
+                np.uint32
+            )[:, None]
+            self._bw = w
+        return w
+
+    def _carry_word(self, g, p):
+        """Closed carry word from per-limb generate/propagate (0/1 uint32
+        rows): bit i of the result = carry INTO position i."""
+        gb = jnp.sum(g * self._bit_weights, axis=0, dtype=jnp.uint32)
+        pb = jnp.sum(p * self._bit_weights, axis=0, dtype=jnp.uint32)
+        b = gb | pb
+        return (gb + b) ^ gb ^ b
+
+    def _ks_carry(self, s):
+        """Normalize (nlimbs, B) limbs with values < 2^17 to canonical 16-bit
+        limbs via bit-packed carry-lookahead. Returns (limbs, carry_out)."""
+        r = s & LIMB_MASK
+        g = s >> LIMB_BITS  # 0/1
+        p = (r == LIMB_MASK).astype(jnp.uint32)
+        c = self._carry_word(g, p)
+        cin = (c[None, :] >> jnp.arange(self.nlimbs, dtype=jnp.uint32)[:, None]) & 1
+        out = (r + cin) & LIMB_MASK
+        return out, ((c >> self.nlimbs) & 1).astype(bool)
+
+    def _borrow_chain(self, t):
+        """Closed borrow bits for int32 limb differences t (t<0 generates a
+        borrow, t==0 propagates one). Returns (borrow_in, borrowed_past_top)."""
+        g = (t < 0).astype(jnp.uint32)
+        p = (t == 0).astype(jnp.uint32)
+        c = self._carry_word(g, p)
+        bin_ = (c[None, :] >> jnp.arange(self.nlimbs, dtype=jnp.uint32)[:, None]) & 1
+        return bin_.astype(jnp.int32), ((c >> self.nlimbs) & 1).astype(bool)
+
+    def _cond_sub_p(self, r):
+        """Canonicalize r (< 2p, canonical limbs) to r mod p."""
+        t = r.astype(jnp.int32) - jnp.asarray(self.p_limbs_np, jnp.int32)[:, None]
+        b, borrowed = self._borrow_chain(t)
+        out = ((t - b) & LIMB_MASK).astype(jnp.uint32)
+        return jnp.where(borrowed, r, out)  # borrowed past top -> r < p
+
     # -- public ring ops ----------------------------------------------------
 
     def add(self, a, b):
-        return self._add_rows([a[i] for i in range(self.nlimbs)],
-                              [b[i] for i in range(self.nlimbs)])
+        r, _ = self._ks_carry(a + b)  # a, b < p so a+b < 2p < 2^256
+        return self._cond_sub_p(r)
 
     def sub(self, a, b):
-        return self._sub_rows(a, b)
+        t = a.astype(jnp.int32) - b.astype(jnp.int32)
+        bor, borrowed = self._borrow_chain(t)
+        raw = ((t - bor) & LIMB_MASK).astype(jnp.uint32)  # a-b mod 2^256
+        # if a < b, add p back
+        padd = jnp.where(
+            borrowed, jnp.asarray(self.p_limbs_np, jnp.uint32)[:, None], 0
+        )
+        r, _ = self._ks_carry(raw + padd)
+        return r
 
     def neg(self, a):
-        zero = jnp.zeros_like(a)
-        return self._sub_rows(zero, a)
+        return self.sub(jnp.zeros_like(a), a)
 
     def mul(self, a, b):
         """Montgomery product. Pallas kernel on TPU, pure XLA elsewhere."""
         if self.use_pallas:
             return self._mul_pallas(a, b)
-        return self._mul_cols(a, b)
+        return self._mul_cols_vec(a, b)
+
+    def _mul_cols_vec(self, a, b):
+        """Same CIOS Montgomery product as `_mul_cols`, but expressed with
+        (n, n, B) tensor ops and slice-updates instead of fully unrolled
+        per-limb scalar graphs.
+
+        Rationale: `_mul_cols` unrolls to ~n^2*6 primitive ops, which is what
+        the Pallas kernel wants (Mosaic compiles it to tight VPU code) but
+        makes plain-XLA compilation of pairing-sized graphs minutes-slow on
+        CPU. This form is ~6x fewer HLO ops with identical semantics; both
+        paths are cross-validated in tests/test_fp_jax.py.
+        """
+        n = self.nlimbs
+        bsz = a.shape[1]
+        t = a[:, None, :] * b[None, :, :]  # (n, n, B); 16x16-bit products, exact
+        lo = t & LIMB_MASK
+        hi = t >> LIMB_BITS
+        cols = jnp.zeros((2 * n + 1, bsz), jnp.uint32)
+        for i in range(n):
+            cols = cols.at[i : i + n].add(lo[i])
+            cols = cols.at[i + 1 : i + n + 1].add(hi[i])
+        # interleaved Montgomery reduction (identical column algebra to
+        # _mul_cols: per-column magnitudes stay < 2^23, one lazy carry pass)
+        n0 = jnp.uint32(self.n0)
+        p_col = jnp.asarray(self.p_limbs_np, jnp.uint32)[:, None]  # (n, 1)
+        carry = jnp.zeros((bsz,), jnp.uint32)
+        for i in range(n):
+            t0 = cols[i] + carry
+            m = (t0 * n0) & LIMB_MASK
+            mp = m[None, :] * p_col  # (n, B)
+            mlo = mp & LIMB_MASK
+            mhi = mp >> LIMB_BITS
+            carry = (t0 + mlo[0]) >> LIMB_BITS
+            cols = cols.at[i + 1 : i + n].add(mlo[1:])
+            cols = cols.at[i + 1 : i + n + 1].add(mhi)
+        cols = cols.at[n].add(carry)
+        hi = cols[n : 2 * n]  # column values < 2^23 (CIOS bound)
+        spill = jnp.pad(hi >> LIMB_BITS, ((1, 0), (0, 0)))[:n]  # multi-bit carries
+        r, _ = self._ks_carry((hi & LIMB_MASK) + spill)
+        return self._cond_sub_p(r)
 
     def sqr(self, a):
         return self.mul(a, a)

@@ -39,65 +39,129 @@ class Tower:
             for g in bn._GAMMA[1:]
         ]
 
+    # -- raw limb stacking (ONE carry-propagating Field call for many ops) --
+    #
+    # Every Field.add/sub pays a carry-lookahead + conditional-subtract; the
+    # tower batches all independent adds/subs of a formula into one wide call
+    # (same "batch stacking" discipline as the muls, ops/fp.py). This is what
+    # keeps both XLA graph size (compile time) and Pallas launch count low.
+
+    @staticmethod
+    def _cat(xs):
+        return jnp.concatenate(xs, axis=1)
+
+    @staticmethod
+    def _split(x, k):
+        b = x.shape[1] // k
+        return [x[:, i * b : (i + 1) * b] for i in range(k)]
+
+    def _add_n(self, lhs, rhs):
+        """[(a_i + b_i)] for equal-width limb arrays — one Field.add."""
+        if len(lhs) == 1:
+            return [self.F.add(lhs[0], rhs[0])]
+        return self._split(self.F.add(self._cat(lhs), self._cat(rhs)), len(lhs))
+
+    def _sub_n(self, lhs, rhs):
+        if len(lhs) == 1:
+            return [self.F.sub(lhs[0], rhs[0])]
+        return self._split(self.F.sub(self._cat(lhs), self._cat(rhs)), len(lhs))
+
     # -- Fp2 ---------------------------------------------------------------
 
     def f2_add(self, a, b):
-        return (self.F.add(a[0], b[0]), self.F.add(a[1], b[1]))
+        c = self.F.add(self._cat([a[0], a[1]]), self._cat([b[0], b[1]]))
+        c0, c1 = self._split(c, 2)
+        return (c0, c1)
 
     def f2_sub(self, a, b):
-        return (self.F.sub(a[0], b[0]), self.F.sub(a[1], b[1]))
+        c = self.F.sub(self._cat([a[0], a[1]]), self._cat([b[0], b[1]]))
+        c0, c1 = self._split(c, 2)
+        return (c0, c1)
 
     def f2_neg(self, a):
-        return (self.F.neg(a[0]), self.F.neg(a[1]))
+        z = self._cat([a[0], a[1]])
+        c0, c1 = self._split(self.F.sub(jnp.zeros_like(z), z), 2)
+        return (c0, c1)
 
     def f2_conj(self, a):
         return (a[0], self.F.neg(a[1]))
+
+    def f2_add_many(self, pairs):
+        """[(a+b)] for a list of Fp2 pairs — one Field.add total."""
+        out = self._add_n(
+            [p[0][0] for p in pairs] + [p[0][1] for p in pairs],
+            [p[1][0] for p in pairs] + [p[1][1] for p in pairs],
+        )
+        k = len(pairs)
+        return [(out[i], out[k + i]) for i in range(k)]
+
+    def f2_sub_many(self, pairs):
+        out = self._sub_n(
+            [p[0][0] for p in pairs] + [p[0][1] for p in pairs],
+            [p[1][0] for p in pairs] + [p[1][1] for p in pairs],
+        )
+        k = len(pairs)
+        return [(out[i], out[k + i]) for i in range(k)]
 
     def f2_mul(self, a, b):
         """Karatsuba: 3 base muls in one stacked call.
         (a0+a1 i)(b0+b1 i) = (a0b0 - a1b1) + ((a0+a1)(b0+b1) - a0b0 - a1b1) i
         """
         F = self.F
-        lhs = jnp.concatenate([a[0], a[1], F.add(a[0], a[1])], axis=1)
-        rhs = jnp.concatenate([b[0], b[1], F.add(b[0], b[1])], axis=1)
+        s = F.add(self._cat([a[0], b[0]]), self._cat([a[1], b[1]]))
+        sa, sb = self._split(s, 2)  # a0+a1, b0+b1
+        lhs = self._cat([a[0], a[1], sa])
+        rhs = self._cat([b[0], b[1], sb])
         v0, v1, v2 = _split3(F.mul(lhs, rhs))
-        c0 = F.sub(v0, v1)
-        c1 = F.sub(F.sub(v2, v0), v1)
+        d = F.sub(self._cat([v0, v2]), self._cat([v1, v0]))
+        c0, t = self._split(d, 2)
+        c1 = F.sub(t, v1)
         return (c0, c1)
 
     def f2_sqr(self, a):
         """(a0+a1 i)^2 = (a0+a1)(a0-a1) + 2 a0 a1 i — 2 base muls."""
         F = self.F
-        lhs = jnp.concatenate([F.add(a[0], a[1]), a[0]], axis=1)
-        rhs = jnp.concatenate([F.sub(a[0], a[1]), a[1]], axis=1)
-        prod = F.mul(lhs, rhs)
-        b = prod.shape[1] // 2
-        c0 = prod[:, :b]
-        t = prod[:, b:]
+        m = F.add(a[0], a[1])
+        s = F.sub(a[0], a[1])
+        prod = F.mul(self._cat([m, a[0]]), self._cat([s, a[1]]))
+        c0, t = self._split(prod, 2)
         return (c0, F.add(t, t))
 
     def f2_mul_fp(self, a, s):
         """Fp2 element times a base-field element (2 base muls, stacked)."""
         F = self.F
-        prod = F.mul(
-            jnp.concatenate([a[0], a[1]], axis=1),
-            jnp.concatenate([s, s], axis=1),
-        )
-        b = prod.shape[1] // 2
-        return (prod[:, :b], prod[:, b:])
+        prod = F.mul(self._cat([a[0], a[1]]), self._cat([s, s]))
+        c0, c1 = self._split(prod, 2)
+        return (c0, c1)
+
+    def _x9(self, z):
+        """9*z by add chain on an arbitrary-width limb array (4 adds)."""
+        F = self.F
+        z2 = F.add(z, z)
+        z4 = F.add(z2, z2)
+        z8 = F.add(z4, z4)
+        return F.add(z8, z)
 
     def f2_mul_xi(self, a):
         """Multiply by xi = 9 + i via add chains (no base mul):
-        (9a0 - a1, 9a1 + a0)."""
+        (9a0 - a1, 9a1 + a0). One stacked x9 chain for both components."""
         F = self.F
+        n9 = self._x9(self._cat([a[0], a[1]]))
+        n90, n91 = self._split(n9, 2)
+        return (F.sub(n90, a[1]), F.add(n91, a[0]))
 
-        def x9(x):
-            x2 = F.add(x, x)
-            x4 = F.add(x2, x2)
-            x8 = F.add(x4, x4)
-            return F.add(x8, x)
-
-        return (F.sub(x9(a[0]), a[1]), F.add(x9(a[1]), a[0]))
+    def f2_mul_xi_many(self, elems):
+        """xi * e for a list of Fp2 elements — one stacked x9 chain."""
+        k = len(elems)
+        n9 = self._x9(self._cat([e[0] for e in elems] + [e[1] for e in elems]))
+        parts = self._split(n9, 2 * k)
+        d = self.F.sub(
+            self._cat(parts[:k]), self._cat([e[1] for e in elems])
+        )
+        s = self.F.add(
+            self._cat(parts[k:]), self._cat([e[0] for e in elems])
+        )
+        return list(zip(self._split(d, k), self._split(s, k)))
 
     def f2_inv(self, a):
         """1/(a0+a1 i) = (a0 - a1 i)/(a0^2+a1^2)."""
@@ -148,33 +212,35 @@ class Tower:
     # -- Fp6 ---------------------------------------------------------------
 
     def f6_add(self, a, b):
-        return tuple(self.f2_add(x, y) for x, y in zip(a, b))
+        out = self.f2_add_many(list(zip(a, b)))
+        return tuple(out)
 
     def f6_sub(self, a, b):
-        return tuple(self.f2_sub(x, y) for x, y in zip(a, b))
+        out = self.f2_sub_many(list(zip(a, b)))
+        return tuple(out)
 
     def f6_neg(self, a):
-        return tuple(self.f2_neg(x) for x in a)
+        z = self._cat([a[i][j] for i in range(3) for j in range(2)])
+        parts = self._split(self.F.sub(jnp.zeros_like(z), z), 6)
+        return ((parts[0], parts[1]), (parts[2], parts[3]), (parts[4], parts[5]))
 
     def f6_mul(self, a, b):
         """Toom/Karatsuba: 6 Fp2 muls in ONE stacked f2_mul call
-        (bn254_ref.f6_mul structure)."""
+        (bn254_ref.f6_mul structure); all interpolation adds/subs stacked."""
         a0, a1, a2 = a
         b0, b1, b2 = b
-        lhs = self._f2_stack(
-            [a0, a1, a2, self.f2_add(a1, a2), self.f2_add(a0, a1), self.f2_add(a0, a2)]
+        # the six pre-mul sums in one add call
+        s = self.f2_add_many(
+            [(a1, a2), (a0, a1), (a0, a2), (b1, b2), (b0, b1), (b0, b2)]
         )
-        rhs = self._f2_stack(
-            [b0, b1, b2, self.f2_add(b1, b2), self.f2_add(b0, b1), self.f2_add(b0, b2)]
-        )
+        lhs = self._f2_stack([a0, a1, a2, s[0], s[1], s[2]])
+        rhs = self._f2_stack([b0, b1, b2, s[3], s[4], s[5]])
         t0, t1, t2, u0, u1, u2 = self._f2_unstack(self.f2_mul(lhs, rhs), 6)
-        c0 = self.f2_add(
-            t0, self.f2_mul_xi(self.f2_sub(u0, self.f2_add(t1, t2)))
-        )
-        c1 = self.f2_add(
-            self.f2_sub(u1, self.f2_add(t0, t1)), self.f2_mul_xi(t2)
-        )
-        c2 = self.f2_add(self.f2_sub(u2, self.f2_add(t0, t2)), t1)
+        # pairwise t-sums, then u - sums, in one call each
+        w = self.f2_add_many([(t1, t2), (t0, t1), (t0, t2)])
+        d0, d1, d2 = self.f2_sub_many([(u0, w[0]), (u1, w[1]), (u2, w[2])])
+        x0, x2 = self.f2_mul_xi_many([d0, t2])  # xi*(u0-t1-t2), xi*t2
+        c0, c1, c2 = self.f2_add_many([(t0, x0), (d1, x2), (d2, t1)])
         return (c0, c1, c2)
 
     def f6_mul_v(self, a):
@@ -208,23 +274,23 @@ class Tower:
     # -- Fp12 --------------------------------------------------------------
 
     def f12_mul(self, a, b):
-        """Karatsuba over Fp6: 3 Fp6 muls -> one stacked f6_mul (54x batch)."""
+        """Karatsuba over Fp6: 3 Fp6 muls -> one stacked f6_mul (54x batch);
+        the six karatsuba input sums in one add call."""
         a0, a1 = a
         b0, b1 = b
-        lhs = tuple(
-            self._f2_stack([a0[i], a1[i], self.f2_add(a0[i], a1[i])])
-            for i in range(3)
+        s = self.f2_add_many(
+            [(a0[i], a1[i]) for i in range(3)] + [(b0[i], b1[i]) for i in range(3)]
         )
-        rhs = tuple(
-            self._f2_stack([b0[i], b1[i], self.f2_add(b0[i], b1[i])])
-            for i in range(3)
-        )
+        lhs = tuple(self._f2_stack([a0[i], a1[i], s[i]]) for i in range(3))
+        rhs = tuple(self._f2_stack([b0[i], b1[i], s[3 + i]]) for i in range(3))
         prod = self.f6_mul(lhs, rhs)
         v0, v1, v2 = zip(*(self._f2_unstack(c, 3) for c in prod))
         v0, v1, v2 = tuple(v0), tuple(v1), tuple(v2)
         c0 = self.f6_add(v0, self.f6_mul_v(v1))
-        c1 = self.f6_sub(self.f6_sub(v2, v0), v1)
-        return (c0, c1)
+        # c1 = v2 - v0 - v1: six components, two stacked sub calls
+        d = self.f2_sub_many(list(zip(v2, v0)))
+        c1 = tuple(self.f2_sub_many(list(zip(d, v1))))
+        return (c0, tuple(c1))
 
     def f12_sqr(self, a):
         return self.f12_mul(a, a)
@@ -270,9 +336,14 @@ class Tower:
 
     def f12_frobenius(self, a):
         """x -> x^p (bn254_ref.f12_frobenius structure: conjugate each Fp2
-        coordinate, multiply w-degree-j slots by gamma_j)."""
+        coordinate, multiply w-degree-j slots by gamma_j). All six
+        conjugations in one stacked neg; the 5 gamma muls in one f2_mul."""
         (c00, c01, c02), (c10, c11, c12) = a
         batch = c00[0].shape[1]
+        coords = [c00, c01, c02, c10, c11, c12]
+        z = self._cat([c[1] for c in coords])
+        negs = self._split(self.F.sub(jnp.zeros_like(z), z), 6)
+        conj = [(coords[i][0], negs[i]) for i in range(6)]
 
         def g(j):
             g0, g1 = self._gamma[j]
@@ -281,19 +352,10 @@ class Tower:
                 jnp.broadcast_to(g1, (self.F.nlimbs, batch)),
             )
 
-        # stack the 5 gamma multiplications into one f2_mul call
-        lhs = self._f2_stack(
-            [
-                self.f2_conj(c01),
-                self.f2_conj(c02),
-                self.f2_conj(c10),
-                self.f2_conj(c11),
-                self.f2_conj(c12),
-            ]
-        )
+        lhs = self._f2_stack(conj[1:])
         rhs = self._f2_stack([g(2), g(4), g(1), g(3), g(5)])
         m01, m02, m10, m11, m12 = self._f2_unstack(self.f2_mul(lhs, rhs), 5)
-        return ((self.f2_conj(c00), m01, m02), (m10, m11, m12))
+        return ((conj[0], m01, m02), (m10, m11, m12))
 
     def f12_frobenius2(self, a):
         return self.f12_frobenius(self.f12_frobenius(a))

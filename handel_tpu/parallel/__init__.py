@@ -1,0 +1,22 @@
+"""Multi-chip scaling: device meshes, sharded aggregation, batch fusion.
+
+The reference has no collective-communication layer (SURVEY.md §2.4, §5.8 —
+point-to-point sockets only); this package is the TPU-native addition: scale
+the verification batch axis over a `jax.sharding.Mesh` with XLA collectives
+riding ICI, and fuse many co-located logical nodes' verify requests into one
+device launch.
+"""
+
+from handel_tpu.parallel.sharding import (
+    make_mesh,
+    sharded_pairing_check,
+    sharded_masked_sum_g2,
+)
+from handel_tpu.parallel.batch_verifier import BatchVerifierService
+
+__all__ = [
+    "make_mesh",
+    "sharded_pairing_check",
+    "sharded_masked_sum_g2",
+    "BatchVerifierService",
+]

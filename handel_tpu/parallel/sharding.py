@@ -1,0 +1,133 @@
+"""Mesh sharding for the verification batch plane.
+
+The scaling axis of this framework is the pairing/aggregation batch
+(SURVEY.md §5.7): candidates shard over the mesh's data axis, the registry
+shards over the same devices for the masked G2 segment-sum, and partial sums
+combine with an `all_gather` + log-depth point-add tree (EC point addition is
+not an elementwise monoid, so `psum` does not apply; the gather rides ICI).
+
+Two entry points:
+  * `sharded_masked_sum_g2` — shard_map over the registry axis: each device
+    masked-tree-sums its registry shard for every candidate, then all_gather
+    + combine. Explicit-collective form.
+  * `sharded_pairing_check` — jit + sharding annotations (GSPMD): candidates
+    are data-parallel lanes; XLA partitions the Miller loop/final exp with no
+    cross-lane communication at all.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from handel_tpu.ops.curve import BN254Curves
+from handel_tpu.ops.pairing import BN254Pairing
+
+
+def make_mesh(n_devices: int | None = None, axis: str = "dp") -> Mesh:
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    return Mesh(np.array(devs[:n]), (axis,))
+
+
+def sharded_masked_sum_g2(
+    curves: BN254Curves, mesh: Mesh, n_registry: int, batch: int, axis: str = "dp"
+):
+    """Build a jitted masked G2 aggregation sharded over the registry axis.
+
+    Returns fn(reg_x0, reg_x1, reg_y0, reg_y1, mask) -> projective G2 batch.
+    reg_* are (L, N) limb arrays, mask is (N, batch) bool. Each device owns
+    N/n_dev registry points, computes its partial masked tree-sum for all
+    `batch` candidates, and the partials are all_gathered and combined with
+    ceil(log2 n_dev) further point-add stages — the collective path the
+    reference's serial Combine loop (processing.go:355-361) never needed.
+    """
+    g2 = curves.g2
+    ndev = mesh.shape[axis]
+    if n_registry % ndev:
+        raise ValueError("registry size must divide evenly over the mesh")
+    local_n = n_registry // ndev
+
+    def local_block(reg_x0, reg_x1, reg_y0, reg_y1, mask):
+        # shapes here are per-device: (L, local_n), (local_n, batch)
+        tile = lambda a: jnp.repeat(a, batch, axis=1)
+        Ppt = g2.from_affine(
+            (tile(reg_x0), tile(reg_x1)), (tile(reg_y0), tile(reg_y1))
+        )
+        partial = g2.masked_sum(Ppt, mask.reshape(-1), local_n)
+        # gather every device's partial point: leaves become (ndev, L, batch)
+        gathered = jax.tree_util.tree_map(
+            lambda a: jax.lax.all_gather(a, axis), partial
+        )
+        # combine with a log-depth point-add tree over the leading axis
+        def level(pts, k):
+            while k > 1:
+                half = k // 2
+                lo = jax.tree_util.tree_map(lambda a: a[:half], pts)
+                hi = jax.tree_util.tree_map(lambda a: a[half : 2 * half], pts)
+                s = g2_add_leading(lo, hi)
+                if k % 2:
+                    s = jax.tree_util.tree_map(
+                        lambda a, b: jnp.concatenate([a, b[2 * half : k]], 0),
+                        s,
+                        pts,
+                    )
+                    k = half + 1
+                else:
+                    k = half
+                pts = s
+            return jax.tree_util.tree_map(lambda a: a[0], pts)
+
+        def g2_add_leading(lo, hi):
+            # vmap the complete add over the leading (device) axis
+            return jax.vmap(g2.add)(lo, hi)
+
+        return level(gathered, ndev)
+
+    fn = shard_map(
+        local_block,
+        mesh=mesh,
+        in_specs=(
+            P(None, axis),
+            P(None, axis),
+            P(None, axis),
+            P(None, axis),
+            P(axis, None),
+        ),
+        out_specs=P(),  # combined point replicated on every device
+        check_rep=False,
+    )
+    return jax.jit(fn)
+
+
+def sharded_pairing_check(
+    pairing: BN254Pairing, mesh: Mesh, groups: int, pairs: int = 2, axis: str = "dp"
+):
+    """Jit the batched product-of-pairings check with candidate lanes sharded
+    over the mesh (pure data parallelism: no collectives needed; GSPMD keeps
+    every lane's Miller loop + shared-final-exp on its home device).
+
+    Returns fn(p, q, mask) like BN254Pairing.pairing_check with
+    groups*pairs lanes, chunk-major.
+    """
+    lane_sharding = NamedSharding(mesh, P(None, axis))
+    mask_sharding = NamedSharding(mesh, P(axis))
+
+    def check(p, q, mask):
+        return pairing.pairing_check(p, q, mask, groups)
+
+    return jax.jit(
+        check,
+        in_shardings=(
+            ((lane_sharding, lane_sharding)),
+            (
+                (lane_sharding, lane_sharding),
+                (lane_sharding, lane_sharding),
+            ),
+            mask_sharding,
+        ),
+        out_shardings=NamedSharding(mesh, P(axis)),
+    )

@@ -1,0 +1,12 @@
+"""Simulation & benchmarking harness.
+
+Reference: simul/ (~7.6 kLoC, SURVEY.md §2.5) — orchestrator, TOML config
+matrix, platforms (localhost/AWS), node & master binaries, UDP sync barrier,
+allocator, keygen/registry CSV, metrics monitor, confgenerator, plots.
+
+This package rebuilds that capability Python-first: the localhost platform
+spawns real OS processes running `python -m handel_tpu.sim.node`, synchronized
+by a UDP barrier, reporting to a UDP JSON monitor whose stats land in CSV the
+plots understand. The TPU twist: one process can host thousands of logical
+nodes sharing a single device batch-verifier (parallel/batch_verifier.py).
+"""

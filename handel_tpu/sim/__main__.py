@@ -1,0 +1,38 @@
+"""Simulation orchestrator CLI.
+
+Reference: simul/main.go:24-68 — load the TOML config, run each RunConfig
+in order on the chosen platform, abort a run after MaxTimeout.
+
+Usage: python -m handel_tpu.sim --config sim.toml --workdir out/
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+
+from handel_tpu.sim.config import load_config
+from handel_tpu.sim.platform import run_simulation
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", required=True)
+    ap.add_argument("--workdir", default="sim_out")
+    args = ap.parse_args()
+    cfg = load_config(args.config)
+    results = asyncio.run(run_simulation(cfg, args.workdir))
+    ok = all(r.ok for r in results)
+    for i, r in enumerate(results):
+        status = "success" if r.ok else "FAILED"
+        print(f"run {i}: {status} -> {r.csv_path}")
+        if not r.ok:
+            for out, err in r.outputs:
+                if err:
+                    sys.stderr.write(err.decode(errors="replace"))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,140 @@
+"""Simulation TOML configuration.
+
+Reference: simul/lib/config.go:41-344 — the global section (Network, Curve,
+Encoding, Allocator, MonitorPort, Simulation, MaxTimeout, Retrials) plus a
+`[[runs]]` matrix ({Nodes, Threshold, Failing, Processes, Handel{Period,
+UpdateCount, NodeCount, Timeout, UnsafeSleepTimeOnSigVerify}}), the factory
+methods, and `GetHandelConfig` bridging into the library Config
+(simul/lib/config.go:290-319).
+
+TPU additions: `scheme` ("fake"/"bn254"/"bn254-jax"), `batch_size` (device
+launch width), `shared_verifier` (fuse co-located nodes' batches).
+"""
+
+from __future__ import annotations
+
+import random
+import tomllib
+from dataclasses import dataclass, field
+
+from handel_tpu.core.config import Config
+
+
+@dataclass
+class HandelParams:
+    period_ms: float = 10.0
+    update_count: int = 1
+    fast_path: int = 10
+    timeout_ms: float = 50.0
+    unsafe_sleep_verify_ms: int = 0
+
+    def to_config(self, threshold: int, seed: int) -> Config:
+        c = Config()
+        c.update_period = self.period_ms / 1000.0
+        c.update_count = self.update_count
+        c.fast_path = self.fast_path
+        c.level_timeout = self.timeout_ms / 1000.0
+        c.unsafe_sleep_on_verify_ms = self.unsafe_sleep_verify_ms
+        c.contributions = threshold
+        c.rand = random.Random(seed)
+        return c
+
+
+@dataclass
+class RunConfig:
+    nodes: int = 8
+    threshold: int = 0  # 0 -> default percentage
+    failing: int = 0
+    processes: int = 1
+    handel: HandelParams = field(default_factory=HandelParams)
+
+    def resolved_threshold(self) -> int:
+        if self.threshold > 0:
+            return self.threshold
+        from handel_tpu.core.config import (
+            DEFAULT_CONTRIBUTIONS_PERC,
+            percentage_to_contributions,
+        )
+
+        return percentage_to_contributions(DEFAULT_CONTRIBUTIONS_PERC, self.nodes)
+
+
+@dataclass
+class SimConfig:
+    network: str = "udp"  # udp | tcp | inproc
+    scheme: str = "bn254"
+    allocator: str = "round-robin"
+    monitor_port: int = 0  # 0 -> pick free
+    max_timeout_s: float = 60.0
+    retrials: int = 1
+    batch_size: int = 16
+    shared_verifier: bool = False
+    debug: bool = False
+    runs: list[RunConfig] = field(default_factory=list)
+
+
+def load_config(path: str) -> SimConfig:
+    with open(path, "rb") as f:
+        raw = tomllib.load(f)
+    cfg = SimConfig(
+        network=raw.get("network", "udp"),
+        scheme=raw.get("scheme", raw.get("curve", "bn254")),
+        allocator=raw.get("allocator", "round-robin"),
+        monitor_port=int(raw.get("monitor_port", 0)),
+        max_timeout_s=float(raw.get("max_timeout_s", 60.0)),
+        retrials=int(raw.get("retrials", 1)),
+        batch_size=int(raw.get("batch_size", 16)),
+        shared_verifier=bool(raw.get("shared_verifier", False)),
+        debug=bool(raw.get("debug", False)),
+    )
+    for r in raw.get("runs", []):
+        h = r.get("handel", {})
+        cfg.runs.append(
+            RunConfig(
+                nodes=int(r.get("nodes", 8)),
+                threshold=int(r.get("threshold", 0)),
+                failing=int(r.get("failing", 0)),
+                processes=int(r.get("processes", 1)),
+                handel=HandelParams(
+                    period_ms=float(h.get("period_ms", 10.0)),
+                    update_count=int(h.get("update_count", 1)),
+                    fast_path=int(h.get("fast_path", 10)),
+                    timeout_ms=float(h.get("timeout_ms", 50.0)),
+                    unsafe_sleep_verify_ms=int(h.get("unsafe_sleep_verify_ms", 0)),
+                ),
+            )
+        )
+    if not cfg.runs:
+        cfg.runs.append(RunConfig())
+    return cfg
+
+
+def dump_config(cfg: SimConfig) -> str:
+    """SimConfig -> TOML text (tomllib is read-only; layout kept trivial)."""
+    lines = [
+        f'network = "{cfg.network}"',
+        f'scheme = "{cfg.scheme}"',
+        f'allocator = "{cfg.allocator}"',
+        f"monitor_port = {cfg.monitor_port}",
+        f"max_timeout_s = {cfg.max_timeout_s}",
+        f"retrials = {cfg.retrials}",
+        f"batch_size = {cfg.batch_size}",
+        f"shared_verifier = {str(cfg.shared_verifier).lower()}",
+        f"debug = {str(cfg.debug).lower()}",
+    ]
+    for r in cfg.runs:
+        lines += [
+            "",
+            "[[runs]]",
+            f"nodes = {r.nodes}",
+            f"threshold = {r.threshold}",
+            f"failing = {r.failing}",
+            f"processes = {r.processes}",
+            "[runs.handel]",
+            f"period_ms = {r.handel.period_ms}",
+            f"update_count = {r.handel.update_count}",
+            f"fast_path = {r.handel.fast_path}",
+            f"timeout_ms = {r.handel.timeout_ms}",
+            f"unsafe_sleep_verify_ms = {r.handel.unsafe_sleep_verify_ms}",
+        ]
+    return "\n".join(lines) + "\n"

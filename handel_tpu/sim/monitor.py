@@ -1,0 +1,162 @@
+"""Metrics plane: UDP JSON sink, measures, stats aggregation, CSV output.
+
+Reference: simul/monitor/ — nodes `ConnectSink` and push JSON measures
+(monitor.go:41-156, measure.go:33-229); the master aggregates per-key
+min/max/avg/sum/dev columns (stats.go:23-480) into the CSV schema the plots
+consume (simul/plots/csv/*.csv headers, e.g. `sigen_wall_avg`).
+
+Measure kinds mirrored here: `TimeMeasure` (wall + user/system CPU via
+resource.getrusage, measure.go:54-143 + rtime.go:17-26), `CounterIO`
+(delta of a Values() map), and single values. The TPU addition: kernel-time
+counters flow through the same pipe (SURVEY.md §5.1).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import math
+import resource
+import time
+from typing import Mapping
+
+
+# -- node side: the sink client ---------------------------------------------
+
+
+class Sink:
+    """Fire-and-forget UDP JSON metric emitter (monitor.go ConnectSink)."""
+
+    def __init__(self, addr: str):
+        host, _, port = addr.rpartition(":")
+        self.addr = (host or "127.0.0.1", int(port))
+        import socket
+
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+
+    def record(self, name: str, values: Mapping[str, float]) -> None:
+        payload = {"name": name, "values": {k: float(v) for k, v in values.items()}}
+        try:
+            self._sock.sendto(json.dumps(payload).encode(), self.addr)
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        self._sock.close()
+
+
+class TimeMeasure:
+    """Wall + user/system CPU interval measure (measure.go:54-143)."""
+
+    def __init__(self, sink: Sink, name: str):
+        self.sink = sink
+        self.name = name
+        self._wall = time.perf_counter()
+        ru = resource.getrusage(resource.RUSAGE_SELF)
+        self._user, self._sys = ru.ru_utime, ru.ru_stime
+
+    def record(self) -> None:
+        ru = resource.getrusage(resource.RUSAGE_SELF)
+        self.sink.record(
+            self.name,
+            {
+                "wall": time.perf_counter() - self._wall,
+                "user": ru.ru_utime - self._user,
+                "system": ru.ru_stime - self._sys,
+            },
+        )
+
+
+class CounterIO:
+    """Delta-of-Values() measure (measure.go CounterMeasure): snapshot a
+    reporter's counters at construction, record the difference."""
+
+    def __init__(self, sink: Sink, name: str, reporter):
+        self.sink = sink
+        self.name = name
+        self.reporter = reporter
+        self._base = dict(reporter.values())
+
+    def record(self) -> None:
+        now = self.reporter.values()
+        self.sink.record(
+            self.name,
+            {k: now[k] - self._base.get(k, 0.0) for k in now},
+        )
+
+
+# -- master side: the sink server + stats ------------------------------------
+
+
+class _SinkProto(asyncio.DatagramProtocol):
+    def __init__(self, mon: "Monitor"):
+        self.mon = mon
+
+    def connection_made(self, transport):
+        self.mon._transport = transport
+
+    def datagram_received(self, data: bytes, addr) -> None:
+        try:
+            msg = json.loads(data.decode())
+            name = str(msg["name"])
+            values = msg["values"]
+        except (ValueError, KeyError):
+            return
+        for k, v in values.items():
+            self.mon.stats.update(f"{name}_{k}", float(v))
+
+
+class Monitor:
+    """UDP sink aggregating every node's measures (monitor.go:41-156)."""
+
+    def __init__(self, port: int):
+        self.port = port
+        self.stats = Stats()
+        self._transport = None
+
+    async def start(self) -> None:
+        loop = asyncio.get_running_loop()
+        await loop.create_datagram_endpoint(
+            lambda: _SinkProto(self), local_addr=("0.0.0.0", self.port)
+        )
+
+    def stop(self) -> None:
+        if self._transport:
+            self._transport.close()
+
+
+class Stats:
+    """Per-key streaming min/max/avg/sum/dev (stats.go:23-480)."""
+
+    def __init__(self, extra: Mapping[str, float] | None = None):
+        self._keys: dict[str, list[float]] = {}
+        self.extra = dict(extra or {})
+
+    def update(self, key: str, value: float) -> None:
+        self._keys.setdefault(key, []).append(value)
+
+    def columns(self) -> list[str]:
+        cols = sorted(self.extra)
+        for key in sorted(self._keys):
+            cols += [f"{key}_{s}" for s in ("min", "max", "avg", "sum", "dev")]
+        return cols
+
+    def row(self) -> list[float]:
+        out = [self.extra[k] for k in sorted(self.extra)]
+        for key in sorted(self._keys):
+            vs = self._keys[key]
+            n = len(vs)
+            avg = sum(vs) / n
+            dev = math.sqrt(sum((v - avg) ** 2 for v in vs) / n)
+            out += [min(vs), max(vs), avg, sum(vs), dev]
+        return out
+
+    def write_csv(self, path: str, append: bool = False) -> None:
+        import csv as _csv
+
+        mode = "a" if append else "w"
+        with open(path, mode, newline="") as f:
+            w = _csv.writer(f)
+            if not append:
+                w.writerow(self.columns())
+            w.writerow([f"{v:.6g}" for v in self.row()])

@@ -1,0 +1,149 @@
+"""Per-process simulation node entry point.
+
+Reference: simul/node/main.go:33-144 — connect the monitor sink, load config
++ registry CSV, build K Handel instances (one per -id), signal the START
+barrier, run until threshold, record `sigen`/`net`/`sigs` measures, verify
+the final signature against the registry, signal END.
+
+Run as: python -m handel_tpu.sim.node --config C --registry R --master M
+        --monitor MON --run I --ids 1,2,3
+
+All logical nodes in this process share one asyncio loop, one UDP socket per
+node, and (with --shared-verifier) one device batch-verifier launch queue.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+
+from handel_tpu.core.crypto import verify_multisignature
+from handel_tpu.core.handel import Handel
+from handel_tpu.models.registry import new_scheme
+from handel_tpu.network.encoding import CounterEncoding
+from handel_tpu.network.udp import UDPNetwork
+from handel_tpu.network.tcp import TCPNetwork
+from handel_tpu.sim import keys as simkeys
+from handel_tpu.sim.config import load_config
+from handel_tpu.sim.monitor import CounterIO, Sink, TimeMeasure
+from handel_tpu.sim.sync import STATE_END, STATE_START, SyncSlave
+
+MSG = b"handel-tpu simulation message"
+
+
+async def run_node_process(args) -> int:
+    cfg = load_config(args.config)
+    run = cfg.runs[args.run]
+    scheme = new_scheme(cfg.scheme)
+    records = simkeys.read_registry_csv(args.registry)
+    registry = simkeys.registry_from_records(records, scheme)
+    ids = [int(x) for x in args.ids.split(",") if x != ""]
+    threshold = run.resolved_threshold()
+
+    sink = Sink(args.monitor) if args.monitor else None
+
+    # one transport per logical node, bound to its registry address
+    nets, handels = [], []
+    shared_service = None
+    if cfg.shared_verifier and cfg.scheme.endswith("jax"):
+        from handel_tpu.models.bn254_jax import BN254Device
+        from handel_tpu.parallel.batch_verifier import BatchVerifierService
+
+        device = BN254Device(
+            registry.public_keys(), batch_size=cfg.batch_size
+        )
+        shared_service = BatchVerifierService(device)
+
+    for nid in ids:
+        rec = records[nid]
+        enc = CounterEncoding()
+        if cfg.network == "tcp":
+            net = TCPNetwork(rec.address, encoding=enc)
+        else:
+            net = UDPNetwork(rec.address, encoding=enc)
+        await net.start()
+        nets.append(net)
+        sk = simkeys.secret_of(rec, scheme)
+        hconf = run.handel.to_config(threshold, seed=nid)
+        hconf.batch_size = cfg.batch_size
+        if shared_service is not None:
+            hconf.verifier = shared_service.verify
+        h = Handel(
+            net,
+            registry,
+            registry.identity(nid),
+            scheme.constructor,
+            MSG,
+            sk.sign(MSG),
+            hconf,
+        )
+        handels.append((nid, h, net))
+
+    # barrier: ready to start (one slave per logical node id)
+    slaves = []
+    for nid, _, _ in handels:
+        s = SyncSlave(args.master, nid)
+        await s.start()
+        slaves.append(s)
+    await asyncio.gather(
+        *(s.signal_and_wait(STATE_START, cfg.max_timeout_s) for s in slaves)
+    )
+
+    measures = []
+    for nid, h, net in handels:
+        if sink:
+            measures.append(
+                (TimeMeasure(sink, "sigen"), CounterIO(sink, "net", net),
+                 CounterIO(sink, "sigs", h.proc))
+            )
+        else:
+            measures.append(None)
+        h.start()
+
+    async def one_done(h: Handel):
+        ms = await h.final_signatures.get()
+        return ms
+
+    finals = await asyncio.wait_for(
+        asyncio.gather(*(one_done(h) for _, h, _ in handels)),
+        timeout=cfg.max_timeout_s,
+    )
+
+    ok = True
+    for (nid, h, net), ms, m in zip(handels, finals, measures):
+        if m:
+            for meas in m:
+                meas.record()
+        if not verify_multisignature(MSG, ms, registry, scheme.constructor):
+            print(f"node {nid}: FINAL SIGNATURE INVALID", file=sys.stderr)
+            ok = False
+        h.stop()
+        net.stop()
+
+    await asyncio.gather(
+        *(s.signal_and_wait(STATE_END, cfg.max_timeout_s) for s in slaves)
+    )
+    for s in slaves:
+        s.stop()
+    if sink:
+        sink.close()
+    if ok:
+        print(f"node process finished OK ids={ids}")
+    return 0 if ok else 1
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", required=True)
+    ap.add_argument("--registry", required=True)
+    ap.add_argument("--master", required=True)
+    ap.add_argument("--monitor", default="")
+    ap.add_argument("--run", type=int, default=0)
+    ap.add_argument("--ids", required=True)
+    args = ap.parse_args()
+    return asyncio.run(run_node_process(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,154 @@
+"""Simulation platforms: configure -> deploy -> start -> collect.
+
+Reference: simul/platform/platform.go:15-89 (lifecycle), localhost.go:16-266
+(keygen + registry CSV + allocation + process spawning + barriers + stats
+CSV). The AWS platform's role (aws.go) maps to a pod/GKE runner and is out of
+scope for single-host rounds; the localhost platform is the primary vehicle
+(SURVEY.md §2.5).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import socket
+import sys
+
+from handel_tpu.models.registry import new_scheme
+from handel_tpu.sim import keys as simkeys
+from handel_tpu.sim.allocator import new_allocator
+from handel_tpu.sim.config import SimConfig, dump_config
+from handel_tpu.sim.monitor import Monitor
+from handel_tpu.sim.sync import STATE_END, STATE_START, SyncMaster
+
+
+def free_ports(n: int) -> list[int]:
+    """simul/lib/net.go:13-52."""
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+class LocalhostPlatform:
+    """Spawn every node process on this machine (localhost.go:16-266)."""
+
+    def __init__(self, cfg: SimConfig, workdir: str):
+        self.cfg = cfg
+        self.dir = workdir
+        os.makedirs(workdir, exist_ok=True)
+        self.config_path = os.path.join(workdir, "sim.toml")
+        with open(self.config_path, "w") as f:
+            f.write(dump_config(cfg))
+
+    async def start_run(self, run_index: int) -> "RunResult":
+        cfg = self.cfg
+        run = cfg.runs[run_index]
+        scheme = new_scheme(cfg.scheme)
+
+        # ports: node addresses + master + monitor
+        ports = free_ports(run.nodes + 2)
+        addresses = [f"127.0.0.1:{p}" for p in ports[: run.nodes]]
+        master_addr = f"127.0.0.1:{ports[run.nodes]}"
+        monitor_port = cfg.monitor_port or ports[run.nodes + 1]
+
+        # keygen -> registry CSV (localhost.go:79-92)
+        records = simkeys.generate_nodes(scheme, addresses)
+        registry_path = os.path.join(self.dir, f"registry_{run_index}.csv")
+        simkeys.write_registry_csv(registry_path, records)
+
+        # allocation (localhost.go:82-120): offline nodes never launch
+        alloc = new_allocator(cfg.allocator).allocate(
+            run.nodes, 1, run.processes, run.failing
+        )
+        by_proc: dict[int, list[int]] = {}
+        for nid, slot in alloc.items():
+            if slot.active:
+                by_proc.setdefault(slot.process, []).append(nid)
+        active = sum(len(v) for v in by_proc.values())
+
+        # master services
+        monitor = Monitor(monitor_port)
+        await monitor.start()
+        sync = SyncMaster(int(master_addr.rsplit(":", 1)[1]), active)
+        await sync.start()
+
+        procs = []
+        try:
+            for pidx, ids in sorted(by_proc.items()):
+                cmd = [
+                    sys.executable,
+                    "-m",
+                    "handel_tpu.sim.node",
+                    "--config",
+                    self.config_path,
+                    "--registry",
+                    registry_path,
+                    "--master",
+                    master_addr,
+                    "--monitor",
+                    f"127.0.0.1:{monitor_port}",
+                    "--run",
+                    str(run_index),
+                    "--ids",
+                    ",".join(map(str, ids)),
+                ]
+                procs.append(
+                    await asyncio.create_subprocess_exec(
+                        *cmd,
+                        stdout=asyncio.subprocess.PIPE,
+                        stderr=asyncio.subprocess.PIPE,
+                    )
+                )
+
+            await sync.wait_all(STATE_START, cfg.max_timeout_s)
+            await sync.wait_all(STATE_END, cfg.max_timeout_s)
+
+            outs = await asyncio.gather(*(p.communicate() for p in procs))
+            rcs = [p.returncode for p in procs]
+        finally:
+            for p in procs:
+                if p.returncode is None:
+                    p.kill()
+            sync.stop()
+            monitor.stop()
+
+        # stats CSV (localhost.go:201-206)
+        monitor.stats.extra = {
+            "run": float(run_index),
+            "nodes": float(run.nodes),
+            "threshold": float(run.resolved_threshold()),
+            "failing": float(run.failing),
+        }
+        csv_path = os.path.join(self.dir, f"results_{run_index}.csv")
+        monitor.stats.write_csv(csv_path)
+        ok = all(rc == 0 for rc in rcs) and all(
+            b"finished OK" in out for out, _ in outs
+        )
+        return RunResult(ok=ok, csv_path=csv_path, outputs=outs, returncodes=rcs)
+
+
+class RunResult:
+    def __init__(self, ok, csv_path, outputs, returncodes):
+        self.ok = ok
+        self.csv_path = csv_path
+        self.outputs = outputs
+        self.returncodes = returncodes
+
+
+async def run_simulation(cfg: SimConfig, workdir: str) -> list[RunResult]:
+    """Orchestrator: run every RunConfig sequentially (simul/main.go:24-68)."""
+    plat = LocalhostPlatform(cfg, workdir)
+    results = []
+    for i in range(len(cfg.runs)):
+        for attempt in range(cfg.retrials):
+            res = await plat.start_run(i)
+            if res.ok:
+                break
+        results.append(res)
+    return results

@@ -1,0 +1,137 @@
+"""UDP distributed barrier for simulation runs.
+
+Reference: simul/lib/sync.go:27-378 — slaves spam READY(state) datagrams with
+their ids every 500 ms; the master counts distinct ids per state and releases
+the barrier once it has seen 99.5% of the expected count (probabilistic
+early release, sync.go:92-98,170, masking straggler datagram loss), then
+acks every subsequent READY so late slaves unblock too. States: START, END.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+STATE_START = 1
+STATE_END = 2
+
+RESEND_PERIOD = 0.5  # slave READY period (sync.go)
+RELEASE_FRACTION = 0.995  # probabilistic early release (sync.go:92-98)
+
+
+class _MasterProto(asyncio.DatagramProtocol):
+    def __init__(self, master: "SyncMaster"):
+        self.master = master
+
+    def connection_made(self, transport):
+        self.master._transport = transport
+
+    def datagram_received(self, data: bytes, addr) -> None:
+        try:
+            msg = json.loads(data.decode())
+        except ValueError:
+            return
+        self.master._on_ready(int(msg["state"]), int(msg["id"]), addr)
+
+
+class SyncMaster:
+    """Barrier master expecting `expected` distinct ids per state
+    (sync.go:163-260)."""
+
+    def __init__(self, listen_port: int, expected: int):
+        self.port = listen_port
+        self.expected = expected
+        self._transport = None
+        self._seen: dict[int, set[int]] = {}
+        self._released: dict[int, asyncio.Event] = {}
+        self._addrs: dict[int, set] = {}
+
+    def _event(self, state: int) -> asyncio.Event:
+        if state not in self._released:
+            self._released[state] = asyncio.Event()
+        return self._released[state]
+
+    async def start(self) -> None:
+        loop = asyncio.get_running_loop()
+        await loop.create_datagram_endpoint(
+            lambda: _MasterProto(self), local_addr=("0.0.0.0", self.port)
+        )
+
+    def stop(self) -> None:
+        if self._transport:
+            self._transport.close()
+
+    def _on_ready(self, state: int, node_id: int, addr) -> None:
+        self._seen.setdefault(state, set()).add(node_id)
+        self._addrs.setdefault(state, set()).add(addr)
+        need = max(1, int(self.expected * RELEASE_FRACTION))
+        if len(self._seen[state]) >= need:
+            self._event(state).set()
+        if self._event(state).is_set():
+            # ack so the sender stops resending (and stragglers unblock)
+            self._transport.sendto(
+                json.dumps({"state": state, "ack": True}).encode(), addr
+            )
+
+    async def wait_all(self, state: int, timeout: float | None = None) -> None:
+        await asyncio.wait_for(self._event(state).wait(), timeout)
+        # ack everyone who already reported
+        for addr in self._addrs.get(state, ()):
+            self._transport.sendto(
+                json.dumps({"state": state, "ack": True}).encode(), addr
+            )
+
+
+class _SlaveProto(asyncio.DatagramProtocol):
+    def __init__(self, slave: "SyncSlave"):
+        self.slave = slave
+
+    def connection_made(self, transport):
+        self.slave._transport = transport
+
+    def datagram_received(self, data: bytes, addr) -> None:
+        try:
+            msg = json.loads(data.decode())
+        except ValueError:
+            return
+        if msg.get("ack"):
+            ev = self.slave._acked.get(int(msg["state"]))
+            if ev:
+                ev.set()
+
+
+class SyncSlave:
+    """Barrier participant (sync.go:263-344): signal readiness for a state
+    and wait for the master's release ack."""
+
+    def __init__(self, master_addr: str, node_id: int):
+        host, _, port = master_addr.rpartition(":")
+        self.master = (host or "127.0.0.1", int(port))
+        self.node_id = node_id
+        self._transport = None
+        self._acked: dict[int, asyncio.Event] = {}
+
+    async def start(self) -> None:
+        loop = asyncio.get_running_loop()
+        await loop.create_datagram_endpoint(
+            lambda: _SlaveProto(self), remote_addr=self.master
+        )
+
+    def stop(self) -> None:
+        if self._transport:
+            self._transport.close()
+
+    async def signal_and_wait(self, state: int, timeout: float | None = None) -> None:
+        ev = self._acked.setdefault(state, asyncio.Event())
+        payload = json.dumps({"state": state, "id": self.node_id}).encode()
+
+        async def spam():
+            while not ev.is_set():
+                self._transport.sendto(payload)
+                await asyncio.sleep(RESEND_PERIOD)
+
+        task = asyncio.get_running_loop().create_task(spam())
+        try:
+            await asyncio.wait_for(ev.wait(), timeout)
+        finally:
+            task.cancel()

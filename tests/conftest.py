@@ -3,14 +3,36 @@
 Tests never require the real TPU: JAX runs on CPU with 8 virtual devices so
 sharding/mesh tests exercise real multi-device code paths
 (xla_force_host_platform_device_count, see task spec / SURVEY.md §7).
-This must run before any `import jax` anywhere in the test session.
+
+The environment may pre-register an experimental TPU platform plugin at
+interpreter startup (a sitecustomize that calls
+`jax.config.update("jax_platforms", ...)`), which overrides the JAX_PLATFORMS
+environment variable — so setting the env var is NOT enough. We re-override
+through the config API, which wins over any earlier update, and clear any
+already-initialized backends so the CPU selection actually engages.
+This must run before any test imports jax-dependent modules.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
+        _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+# persistent compile cache: pairing-sized graphs take tens of seconds to
+# compile on CPU the first time; reruns hit the disk cache
+jax.config.update("jax_compilation_cache_dir", "/tmp/handel_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+from jax._src import xla_bridge as _xb
+
+if _xb.backends_are_initialized():  # a plugin already built a backend set
+    from jax.extend.backend import clear_backends
+
+    clear_backends()

@@ -1,0 +1,33 @@
+"""Gossip baseline tests (simul/p2p/test/test.go:23-50 shape)."""
+
+import asyncio
+
+import pytest
+
+from handel_tpu.baselines.gossip import run_gossip
+from handel_tpu.core.crypto import verify_multisignature
+
+
+def test_gossip_full_mesh():
+    results = asyncio.run(run_gossip(8, threshold=5, connector="full"))
+    assert len(results) == 8
+    for ms in results.values():
+        assert ms.bitset.cardinality() >= 5
+
+
+def test_gossip_random_fanout():
+    results = asyncio.run(
+        run_gossip(10, threshold=6, connector="random", fanout=4)
+    )
+    assert all(ms.bitset.cardinality() >= 6 for ms in results.values())
+
+
+def test_gossip_aggregate_then_verify_real_crypto():
+    from handel_tpu.models.bn254 import BN254Scheme
+
+    scheme = BN254Scheme()
+    results = asyncio.run(
+        run_gossip(4, threshold=3, scheme=scheme, verify_incoming=False,
+                   timeout=60.0)
+    )
+    assert len(results) == 4

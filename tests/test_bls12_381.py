@@ -1,0 +1,96 @@
+"""BLS12-381 scalar pairing + scheme tests.
+
+Mirrors the per-curve test shape of the reference (bn256/*/bn256_test.go:
+sign/verify/combine/marshal + small end-to-end), plus the pairing-math
+property tests that pin the M-twist line placement and the hard-part
+identity (3·hard = (z-1)^2 (z+p)(z^2+p^2-1) + 3).
+"""
+
+import random
+
+import pytest
+
+from handel_tpu.core.crypto import verify_multisignature
+from handel_tpu.models.bls12_381 import (
+    BLS12381Scheme,
+    new_keypair,
+    unmarshal_g1,
+    unmarshal_g2,
+)
+from handel_tpu.ops import bls12_381_ref as bls
+
+
+def test_hard_part_identity():
+    hard = (bls.P**4 - bls.P**2 + 1) // bls.R
+    assert 3 * hard == (bls.Z - 1) ** 2 * (bls.Z + bls.P) * (
+        bls.Z**2 + bls.P**2 - 1
+    ) + 3
+
+
+def test_generators_valid():
+    assert bls.g1_is_valid(bls.G1_GEN)
+    assert bls.g2_is_valid(bls.G2_GEN)
+
+
+def test_fast_final_exp_is_cube_of_naive():
+    f = bls.miller_loop(bls.G2_GEN, bls.G1_GEN)
+    e = bls.final_exponentiation_naive(f)
+    cube = bls.f12_mul(bls.f12_mul(e, e), e)
+    assert bls.final_exponentiation(f) == cube
+    assert e != bls.F12_ONE  # non-degenerate
+
+
+def test_bilinearity():
+    rng = random.Random(3)
+    k, l = rng.randrange(1, bls.R), rng.randrange(1, bls.R)
+    lhs = bls.pairing(bls.g2_mul(bls.G2_GEN, l), bls.g1_mul(bls.G1_GEN, k))
+    rhs = bls.f12_pow(bls.pairing(bls.G2_GEN, bls.G1_GEN), k * l % bls.R)
+    assert lhs == rhs
+
+
+def test_sign_verify_combine():
+    msg = b"hello bls12-381"
+    sk1, pk1 = new_keypair(seed=1)
+    sk2, pk2 = new_keypair(seed=2)
+    s1, s2 = sk1.sign(msg), sk2.sign(msg)
+    assert pk1.verify(msg, s1)
+    assert not pk2.verify(msg, s1)
+    agg_sig = s1.combine(s2)
+    agg_pk = pk1.combine(pk2)
+    assert agg_pk.verify(msg, agg_sig)
+    assert not agg_pk.verify(b"other", agg_sig)
+
+
+def test_marshal_roundtrip():
+    sk, pk = new_keypair(seed=7)
+    sig = sk.sign(b"m")
+    assert unmarshal_g1(sig.marshal()) == sig.point
+    assert unmarshal_g2(pk.marshal()) == pk.point
+    with pytest.raises(ValueError):
+        unmarshal_g1(b"\xff" * 96)
+
+
+def test_scheme_registry_dispatch():
+    from handel_tpu.models.registry import new_scheme
+
+    s = new_scheme("bls12-381")
+    assert isinstance(s, BLS12381Scheme)
+    sk, pk = s.keygen(3)
+    assert s.unmarshal_public(pk.marshal()) == pk
+    assert s.unmarshal_secret(sk.marshal()).scalar == sk.scalar
+
+
+@pytest.mark.slow
+def test_protocol_e2e_bls12_381():
+    """Small aggregation run on the in-process network with real BLS12-381
+    (tier-3 analogue of bn256/cf/bn256_test.go:13-37)."""
+    import asyncio
+
+    from handel_tpu.core.test_harness import run_cluster
+
+    results = asyncio.run(
+        run_cluster(5, timeout=120.0, scheme=BLS12381Scheme())
+    )
+    assert len(results) == 5
+    for sig in results.values():
+        assert sig.cardinality() >= 3

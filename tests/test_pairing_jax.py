@@ -1,0 +1,106 @@
+"""JAX pairing kernels vs the scalar oracle.
+
+Validates the batched Miller loop + final exponentiation (ops/pairing.py)
+bit-exactly against ops/bn254_ref.py (VERDICT r1 item 1: >= random vectors
+matching `bn254_ref.pairing`, bilinearity, masked lanes, product check), all
+on CPU (tests/conftest.py forces the CPU platform).
+
+Shapes are kept identical across tests (B=4 lanes) so each graph compiles
+once into the persistent cache; first run is compile-heavy, reruns are fast.
+"""
+
+import random
+
+import jax
+import pytest
+
+from handel_tpu.ops import bn254_ref as bn
+from handel_tpu.ops.curve import BN254Curves
+from handel_tpu.ops.pairing import BN254Pairing
+
+B = 4  # lane count shared by every test
+
+
+@pytest.fixture(scope="module")
+def stack():
+    curves = BN254Curves()
+    return curves, BN254Pairing(curves)
+
+
+def _pack_pairs(curves, g1s, g2s):
+    xp = curves.F.pack([p[0] for p in g1s])
+    yp = curves.F.pack([p[1] for p in g1s])
+    xq = curves.T.f2_pack([q[0] for q in g2s])
+    yq = curves.T.f2_pack([q[1] for q in g2s])
+    return (xp, yp), (xq, yq)
+
+
+def _rand_points(seed):
+    rng = random.Random(seed)
+    ks = [rng.randrange(1, bn.R) for _ in range(B)]
+    ls = [rng.randrange(1, bn.R) for _ in range(B)]
+    g1s = [bn.g1_mul(bn.G1_GEN, k) for k in ks]
+    g2s = [bn.g2_mul(bn.G2_GEN, l) for l in ls]
+    return ks, ls, g1s, g2s
+
+
+def test_miller_loop_matches_oracle(stack):
+    curves, pr = stack
+    _, _, g1s, g2s = _rand_points(1)
+    p, q = _pack_pairs(curves, g1s, g2s)
+    f = jax.jit(lambda p, q: pr.miller_loop(p, q))(p, q)
+    got = curves.T.f12_unpack(f)
+    exp = [bn.miller_loop_projective(q_, p_) for p_, q_ in zip(g1s, g2s)]
+    assert got == exp
+
+
+def test_pairing_matches_oracle_and_bilinear(stack):
+    curves, pr = stack
+    ks, ls, g1s, g2s = _rand_points(1)
+    p, q = _pack_pairs(curves, g1s, g2s)
+    jit_pairing = jax.jit(lambda p, q: pr.pairing(p, q))
+    f = jit_pairing(p, q)
+    got = curves.T.f12_unpack(f)
+    exp = [bn.pairing(q_, p_) for p_, q_ in zip(g1s, g2s)]
+    assert got == exp
+    # bilinearity through the oracle: e([k]G1, [l]G2) == e(G1, G2)^(k*l)
+    base = bn.pairing(bn.G2_GEN, bn.G1_GEN)
+    for k, l, val in zip(ks, ls, got):
+        assert val == bn.f12_pow(base, k * l % bn.R)
+
+
+def test_masked_lanes_give_identity(stack):
+    import jax.numpy as jnp
+
+    curves, pr = stack
+    _, _, g1s, g2s = _rand_points(2)
+    p, q = _pack_pairs(curves, g1s, g2s)
+    mask = jnp.asarray([True, False, True, False])
+    f = jax.jit(lambda p, q, m: pr.miller_loop(p, q, m))(p, q, mask)
+    got = curves.T.f12_unpack(f)
+    assert got[1] == bn.F12_ONE and got[3] == bn.F12_ONE
+    assert got[0] == bn.miller_loop_projective(g2s[0], g1s[0])
+
+
+def test_pairing_check_bls_verify(stack):
+    """The batched product check accepts valid BLS pairs and rejects a
+    corrupted signature — the shape used by batch_verify
+    (bn256/go/bn256.go:82-94 as one product check)."""
+    import jax.numpy as jnp
+
+    curves, pr = stack
+    rng = random.Random(7)
+    msg_scalar = rng.randrange(1, bn.R)
+    h = bn.g1_mul(bn.G1_GEN, msg_scalar)  # H(m)
+    sks = [rng.randrange(1, bn.R) for _ in range(2)]
+    pks = [bn.g2_mul(bn.G2_GEN, sk) for sk in sks]
+    sigs = [bn.g1_mul(h, sk) for sk in sks]
+    bad_sig = bn.g1_mul(h, sks[1] + 1)  # candidate 1 corrupted
+
+    # 2 candidates x 2 pairs, chunk-major: [h, h, -s0, -bad]
+    g1s = [h, h, bn.g1_neg(sigs[0]), bn.g1_neg(bad_sig)]
+    g2s = [pks[0], pks[1], bn.G2_GEN, bn.G2_GEN]
+    p, q = _pack_pairs(curves, g1s, g2s)
+    mask = jnp.ones((B,), bool)
+    ok = jax.jit(lambda p, q, m: pr.pairing_check(p, q, m, 2))(p, q, mask)
+    assert list(map(bool, ok)) == [True, False]

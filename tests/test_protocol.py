@@ -109,3 +109,84 @@ def test_larger_cluster_slow():
     # reference: TestHandelTestNetworkLarge guarded by testing.Short()
     results = run(run_cluster(64, timeout=30.0))
     assert len(results) == 64
+
+
+def test_flaky_verifier_requeues():
+    """A transiently failing verifier must not lose candidates: errored
+    batches are requeued (with a retry cap) and aggregation completes.
+    Matches the per-signature error handling intent of processing.go:282-284."""
+    import random
+
+    from handel_tpu.core.config import Config
+
+    calls = {"n": 0}
+    cons = FakeConstructor()
+
+    async def flaky(msg, pubkeys, requests):
+        calls["n"] += 1
+        if calls["n"] % 2 == 1:
+            raise RuntimeError("transient device error")
+        return cons.batch_verify(msg, pubkeys, requests)
+
+    def cfg_factory(i):
+        c = Config()
+        c.verifier = flaky
+        c.rand = random.Random(42 + i)
+        return c
+
+    results = run(run_cluster(8, timeout=25.0, config_factory=cfg_factory))
+    assert len(results) == 8
+    assert calls["n"] > 0
+
+
+def test_requeue_retry_cap():
+    """After max_retries verifier errors a candidate is dropped, not spun on
+    forever."""
+    import random as _random
+
+    from handel_tpu.core.bitset import BitSet
+    from handel_tpu.core.crypto import MultiSignature
+    from handel_tpu.core.partitioner import BinomialPartitioner, IncomingSig
+    from handel_tpu.core.processing import BatchProcessing
+    from handel_tpu.models.fake import FakeSignature
+
+    from handel_tpu.core.identity import ArrayRegistry, Identity
+    from handel_tpu.models.fake import FakePublic
+
+    async def go():
+        reg = ArrayRegistry(
+            [Identity(i, f"x-{i}", FakePublic(True)) for i in range(8)]
+        )
+        part = BinomialPartitioner(0, reg)
+        verified = []
+
+        async def always_fail(msg, pubkeys, requests):
+            raise RuntimeError("dead device")
+
+        proc = BatchProcessing(
+            part,
+            FakeConstructor(),
+            b"m",
+            [None] * 8,
+            type("E", (), {"evaluate": staticmethod(lambda sp: 1)})(),
+            verified.append,
+            verifier=always_fail,
+        )
+        proc.start()
+        bs = BitSet(1)
+        bs.set(0)
+        sp = IncomingSig(origin=1, level=1, ms=MultiSignature(bs, FakeSignature()))
+        proc.add(sp)
+        # let the loop run: 1 initial + max_retries attempts, then drop
+        for _ in range(40):
+            await asyncio.sleep(0.01)
+            if sp.verify_tries > proc.max_retries:
+                break
+        proc.stop()
+        assert sp.verify_tries == proc.max_retries + 1
+        assert not verified
+        assert not proc._todos or all(
+            s.verify_tries <= proc.max_retries for s in proc._todos
+        )
+
+    run(go())

@@ -1,0 +1,99 @@
+"""Protocol tests at reference scale (slow tier).
+
+Reference tables: handel_test.go:30-40 (TestHandelWithFailures: 333 nodes,
+24 offline, threshold 51%), :53-84 (TestHandelTestNetworkFull to 128 nodes /
+TestHandelTestNetworkLarge behind testing.Short()), and the loss-rate
+scenario exercising the harness's lossy router (test_harness.py loss_rate —
+packets vanish like WAN UDP; timeouts + individual-sig patching must win).
+"""
+
+import asyncio
+import random
+
+import pytest
+
+from handel_tpu.core.config import Config
+from handel_tpu.core.test_harness import LocalCluster, run_cluster
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.mark.slow
+def test_full_aggregation_128():
+    results = run(run_cluster(128, timeout=60.0))
+    assert len(results) == 128
+    for sig in results.values():
+        assert sig.cardinality() >= 66
+
+
+@pytest.mark.slow
+def test_with_failures_333():
+    n, offline_ct = 333, 24
+    rng = random.Random(1234)
+    offline = tuple(sorted(rng.sample(range(n), offline_ct)))
+    threshold = (n * 51 + 99) // 100
+
+    async def go():
+        cluster = LocalCluster(n, offline=offline, threshold=threshold)
+        cluster.start()
+        try:
+            return await cluster.wait_complete_success(timeout=120.0)
+        finally:
+            cluster.stop()
+
+    results = run(go())
+    assert len(results) == n - offline_ct
+    for sig in results.values():
+        assert sig.cardinality() >= threshold
+        for off in offline:
+            assert not sig.bitset.get(off)
+
+
+@pytest.mark.slow
+def test_lossy_network_converges():
+    """20% packet loss: periodic resends + timeouts must still converge
+    (the WAN robustness the reference gets from UDP fire-and-forget)."""
+
+    def cfg_factory(i):
+        c = Config()
+        c.rand = random.Random(50 + i)
+        return c
+
+    async def go():
+        cluster = LocalCluster(
+            24, threshold=13, loss_rate=0.2, config_factory=cfg_factory
+        )
+        cluster.start()
+        try:
+            return await cluster.wait_complete_success(timeout=60.0)
+        finally:
+            cluster.stop()
+
+    results = run(go())
+    assert len(results) == 24
+    for sig in results.values():
+        assert sig.cardinality() >= 13
+
+
+@pytest.mark.slow
+def test_real_crypto_37_nodes():
+    """37-node end-to-end with real BN254 (bn256/cf/bn256_test.go:13-37)."""
+    from handel_tpu.core.crypto import verify_multisignature
+    from handel_tpu.models.bn254 import BN254Scheme
+
+    scheme = BN254Scheme()
+
+    async def go():
+        cluster = LocalCluster(37, scheme=scheme, threshold=19)
+        cluster.start()
+        try:
+            return await cluster.wait_complete_success(timeout=600.0)
+        finally:
+            cluster.stop()
+
+    results = run(go())
+    assert len(results) == 37
+    h0 = next(iter(results.values()))
+    assert h0.cardinality() >= 19

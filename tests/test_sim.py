@@ -1,0 +1,169 @@
+"""Simulation harness tests.
+
+Tier-4 of the reference test strategy (SURVEY.md §4): TestMainLocalHost
+(simul/main_test.go:17-60) spawns real processes over real sockets with the
+sync barrier and the monitor, and asserts success + a results CSV. Plus unit
+tests for allocator invariants (allocator_test.go:16), registry CSV
+round-trip (parser_test.go:48), sync barrier (sync_test.go:8), and stats.
+"""
+
+import asyncio
+import csv
+import os
+
+import pytest
+
+from handel_tpu.sim.allocator import RoundRobin, RoundRandomOffline
+from handel_tpu.sim.config import HandelParams, RunConfig, SimConfig, dump_config, load_config
+from handel_tpu.sim.keys import (
+    generate_nodes,
+    read_registry_csv,
+    registry_from_records,
+    secret_of,
+    write_registry_csv,
+)
+from handel_tpu.sim.monitor import Monitor, Sink, Stats
+from handel_tpu.sim.platform import LocalhostPlatform, free_ports
+from handel_tpu.sim.sync import STATE_START, SyncMaster, SyncSlave
+from handel_tpu.models.fake import FakeScheme
+
+
+def test_allocator_invariants():
+    for alloc_cls in (RoundRobin, RoundRandomOffline):
+        alloc = alloc_cls().allocate(40, 2, 4, failing=10)
+        assert len(alloc) == 40
+        assert sum(1 for s in alloc.values() if not s.active) == 10
+        assert {s.process for s in alloc.values()} == set(range(8))
+
+
+def test_registry_csv_roundtrip(tmp_path):
+    scheme = FakeScheme()
+    records = generate_nodes(scheme, [f"127.0.0.1:{4000+i}" for i in range(5)])
+    path = str(tmp_path / "reg.csv")
+    write_registry_csv(path, records)
+    back = read_registry_csv(path)
+    assert [(r.id, r.address) for r in back] == [
+        (r.id, r.address) for r in records
+    ]
+    reg = registry_from_records(back, scheme)
+    assert reg.size() == 5
+    sk = secret_of(back[3], scheme)
+    assert sk.id == 3
+
+
+def test_sync_barrier():
+    async def go():
+        (port,) = [free_ports(1)[0]]
+        master = SyncMaster(port, expected=3)
+        await master.start()
+        slaves = [SyncSlave(f"127.0.0.1:{port}", i) for i in range(3)]
+        for s in slaves:
+            await s.start()
+        await asyncio.gather(
+            master.wait_all(STATE_START, 10.0),
+            *(s.signal_and_wait(STATE_START, 10.0) for s in slaves),
+        )
+        master.stop()
+        for s in slaves:
+            s.stop()
+
+    asyncio.run(go())
+
+
+def test_monitor_stats(tmp_path):
+    async def go():
+        (port,) = free_ports(1)
+        mon = Monitor(port)
+        await mon.start()
+        sink = Sink(f"127.0.0.1:{port}")
+        for v in (1.0, 3.0):
+            sink.record("sigen", {"wall": v})
+        await asyncio.sleep(0.2)
+        mon.stop()
+        sink.close()
+        return mon.stats
+
+    stats = asyncio.run(go())
+    cols = stats.columns()
+    assert "sigen_wall_avg" in cols
+    row = dict(zip(cols, stats.row()))
+    assert row["sigen_wall_avg"] == 2.0
+    assert row["sigen_wall_min"] == 1.0 and row["sigen_wall_max"] == 3.0
+    path = str(tmp_path / "stats.csv")
+    stats.write_csv(path)
+    assert os.path.exists(path)
+
+
+def test_config_toml_roundtrip(tmp_path):
+    cfg = SimConfig(
+        scheme="fake",
+        runs=[RunConfig(nodes=12, threshold=7, failing=2, processes=3,
+                        handel=HandelParams(period_ms=5.0))],
+    )
+    path = tmp_path / "sim.toml"
+    path.write_text(dump_config(cfg))
+    back = load_config(str(path))
+    assert back.scheme == "fake"
+    assert back.runs[0].nodes == 12
+    assert back.runs[0].handel.period_ms == 5.0
+    assert back.runs[0].resolved_threshold() == 7
+
+
+@pytest.mark.parametrize("scheme,nodes,processes,failing", [
+    ("fake", 8, 2, 0),
+    ("fake", 16, 4, 3),
+])
+def test_localhost_platform(tmp_path, scheme, nodes, processes, failing):
+    """TestMainLocalHost equivalent: real processes, UDP, barrier, monitor."""
+    threshold = (nodes - failing) // 2 + 1
+    cfg = SimConfig(
+        network="udp",
+        scheme=scheme,
+        max_timeout_s=60.0,
+        runs=[
+            RunConfig(
+                nodes=nodes,
+                threshold=threshold,
+                failing=failing,
+                processes=processes,
+            )
+        ],
+    )
+
+    async def go():
+        plat = LocalhostPlatform(cfg, str(tmp_path))
+        return await plat.start_run(0)
+
+    res = asyncio.run(go())
+    if not res.ok:
+        for out, err in res.outputs:
+            print(out.decode(errors="replace"))
+            print(err.decode(errors="replace"))
+    assert res.ok
+    assert os.path.exists(res.csv_path)
+    with open(res.csv_path) as f:
+        rows = list(csv.reader(f))
+    header = rows[0]
+    assert "sigen_wall_avg" in header
+    assert any("net_sentBytes" in h for h in header)
+
+
+def test_localhost_platform_bn254_real_crypto(tmp_path):
+    """Small run with real BN254 host crypto end-to-end over real sockets."""
+    cfg = SimConfig(
+        network="udp",
+        scheme="bn254",
+        max_timeout_s=120.0,
+        runs=[RunConfig(nodes=4, threshold=3, processes=2)],
+    )
+
+    async def go():
+        plat = LocalhostPlatform(cfg, str(tmp_path))
+        return await plat.start_run(0)
+
+    res = asyncio.run(go())
+    if not res.ok:
+        for out, err in res.outputs:
+            print(out.decode(errors="replace"))
+            print(err.decode(errors="replace"))
+    assert res.ok
