@@ -1,0 +1,84 @@
+"""Reporter plane: uniform counter surfaces + the aggregating decorator.
+
+Reference: report.go:5-87 — the `Reporter` interface (`Values() map[string]
+float64`), `ReportHandel` wrapping a Handel to also expose its store's and
+network's counters, and `ReportStore` counting merge attempts. Here the
+components already expose `values()` (core/handel.py:355, store, processing,
+networks, parallel/batch_verifier.py); this module adds the missing
+aggregation layer — one object the monitor's CounterIO can snapshot — plus
+the TPU-specific kernel-time counters (SURVEY.md §5.1 "same counter plane +
+kernel time").
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Mapping, Protocol
+
+
+class Reporter(Protocol):
+    """Anything exposing a flat float counter map (report.go:10-13)."""
+
+    def values(self) -> dict[str, float]: ...
+
+
+class ReportAggregator:
+    """Namespaced union of many reporters (report.go ReportHandel, widened:
+    any set of components, each under a prefix).
+
+    >>> agg = ReportAggregator(handel=h, net=net, verifier=svc)
+    >>> agg.values()  # {"handel_msgSentCt": ..., "net_sentPackets": ...}
+    """
+
+    def __init__(self, **reporters: Reporter):
+        self._reporters = dict(reporters)
+
+    def add(self, prefix: str, reporter: Reporter) -> None:
+        self._reporters[prefix] = reporter
+
+    def values(self) -> dict[str, float]:
+        out: dict[str, float] = {}
+        for prefix, rep in self._reporters.items():
+            for k, v in rep.values().items():
+                out[f"{prefix}_{k}"] = float(v)
+        return out
+
+
+class KernelTimer:
+    """Device launch-time counters for the monitor plane.
+
+    Wraps a callable (e.g. BN254Device.batch_verify); accumulates wall time
+    spent inside launches and the launch count. This is the kernel-time trace
+    hook the reference's `sigCheckingTime` counter (processing.go:280)
+    becomes when verification moves on device."""
+
+    def __init__(self, fn, name: str = "kernel"):
+        self._fn = fn
+        self.name = name
+        self.calls = 0
+        self.total_s = 0.0
+        self.max_s = 0.0
+
+    def __call__(self, *args, **kwargs):
+        t0 = time.perf_counter()
+        try:
+            return self._fn(*args, **kwargs)
+        finally:
+            dt = time.perf_counter() - t0
+            self.calls += 1
+            self.total_s += dt
+            self.max_s = max(self.max_s, dt)
+
+    def values(self) -> dict[str, float]:
+        return {
+            f"{self.name}Calls": float(self.calls),
+            f"{self.name}TimeMs": self.total_s * 1000.0,
+            f"{self.name}MaxMs": self.max_s * 1000.0,
+        }
+
+
+def diff_values(
+    before: Mapping[str, float], after: Mapping[str, float]
+) -> dict[str, float]:
+    """Per-key delta of two counter snapshots (measure.go CounterMeasure)."""
+    return {k: after[k] - before.get(k, 0.0) for k in after}

@@ -12,8 +12,11 @@ Wire formats (64-byte G1 = x||y big-endian, 128-byte G2 with imaginary
 coefficient first, zero bytes = point at infinity) mirror cloudflare/bn256's
 Marshal layout.
 
-This scheme is the slow-but-oracle host path; bn254_native.py (C++) and
-bn254_jax.py (TPU) implement the same interface, verified against this one.
+Point arithmetic dispatches to the C++ host library (handel_tpu/native,
+the equivalent of the reference's assembly field ops inside cloudflare/bn256)
+when it builds, and falls back to the pure-Python oracle (ops/bn254_ref.py)
+otherwise; pairings stay on the oracle here. bn254_jax.py (TPU) implements
+the same interface with verification on device, validated against this one.
 The TPU-relevant structure is already here: `batch_verify` goes through one
 product-of-pairings check per candidate, which the device backend turns into a
 single vmap'd multi-pairing launch.
@@ -24,6 +27,7 @@ from __future__ import annotations
 import hashlib
 import secrets
 
+from handel_tpu import native as nat
 from handel_tpu.core.crypto import Constructor
 from handel_tpu.ops import bn254_ref as bn
 
@@ -76,11 +80,12 @@ def unmarshal_g2(data: bytes, check_subgroup: bool = True):
         return None
     x1, x0, y1, y0 = (_bytes_to_int(data[i : i + 32]) for i in range(0, 128, 32))
     pt = ((x0, x1), (y0, y1))
-    if check_subgroup:
-        if not bn.g2_is_valid(pt):
-            raise ValueError("G2 point not on curve / wrong subgroup")
-    elif not bn.pt_is_on_curve(bn.F2_OPS, pt, bn.TWIST_B):
+    if not bn.pt_is_on_curve(bn.F2_OPS, pt, bn.TWIST_B):
         raise ValueError("G2 point not on curve")
+    # subgroup check [r]P == O on the native path (the Python oracle's
+    # g2_is_valid does the same mul ~15x slower — hot in packet unmarshal)
+    if check_subgroup and nat.g2_mul(pt, bn.R) is not None:
+        raise ValueError("G2 point not on curve / wrong subgroup")
     return pt
 
 
@@ -90,7 +95,7 @@ def hash_to_g1(msg: bytes):
     k = int.from_bytes(hashlib.sha256(msg).digest(), "big") % bn.R
     if k == 0:
         k = 1
-    return bn.g1_mul(bn.G1_GEN, k)
+    return nat.g1_mul(bn.G1_GEN, k)
 
 
 class BN254Signature:
@@ -105,7 +110,7 @@ class BN254Signature:
         return marshal_g1(self.point)
 
     def combine(self, other: "BN254Signature") -> "BN254Signature":
-        return BN254Signature(bn.g1_add(self.point, other.point))
+        return BN254Signature(nat.g1_add(self.point, other.point))
 
     def __eq__(self, other):
         return isinstance(other, BN254Signature) and self.point == other.point
@@ -133,7 +138,7 @@ class BN254PublicKey:
         )
 
     def combine(self, other: "BN254PublicKey") -> "BN254PublicKey":
-        return BN254PublicKey(bn.g2_add(self.point, other.point))
+        return BN254PublicKey(nat.g2_add(self.point, other.point))
 
     def __eq__(self, other):
         return isinstance(other, BN254PublicKey) and self.point == other.point
@@ -148,10 +153,10 @@ class BN254SecretKey:
         self.scalar = scalar % bn.R
 
     def public_key(self) -> BN254PublicKey:
-        return BN254PublicKey(bn.g2_mul(bn.G2_GEN, self.scalar))
+        return BN254PublicKey(nat.g2_mul(bn.G2_GEN, self.scalar))
 
     def sign(self, msg: bytes) -> BN254Signature:
-        return BN254Signature(bn.g1_mul(hash_to_g1(msg), self.scalar))
+        return BN254Signature(nat.g1_mul(hash_to_g1(msg), self.scalar))
 
     def marshal(self) -> bytes:
         return int(self.scalar).to_bytes(32, "big")

@@ -199,15 +199,21 @@ class BN254JaxConstructor(BN254Constructor):
             pubkeys, batch_size=self.batch_size, curves=self.curves
         )
         self._device_for = id(pubkeys)
+        self._reg_keys = [pk.point for pk in pubkeys]
         return self._device
 
     def _device_of(self, pubkeys) -> BN254Device:
-        if self._device is None or (
-            self._device_for is not None
-            and self._device_for != id(pubkeys)
-            and self._device.n != len(pubkeys)
-        ):
+        if self._device is None or self._device.n != len(pubkeys):
             self.prepare(pubkeys)
+        elif self._device_for != id(pubkeys):
+            # same length, different list object: full content check once per
+            # new list identity (a same-size registry rebuilt after churn must
+            # NOT verify against stale keys), then adopt the id so repeat
+            # calls stay O(1)
+            if [pk.point for pk in pubkeys] == self._reg_keys:
+                self._device_for = id(pubkeys)
+            else:
+                self.prepare(pubkeys)
         return self._device
 
     def batch_verify(self, msg, pubkeys, requests) -> list[bool]:

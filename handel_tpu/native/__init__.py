@@ -1,0 +1,260 @@
+"""ctypes bindings for the C++ host arithmetic (bn254.cc).
+
+The shared library is built on first use with g++ (no pybind11 in the image;
+plain C ABI + ctypes per the environment constraints) and cached next to the
+source. Every entry point has a pure-Python fallback via ops/bn254_ref.py, so
+nothing breaks where a compiler is unavailable — the native path is a
+host-speed accelerator, not a dependency.
+
+API mirrors the scalar oracle's point representation: affine tuples of ints
+(G2 coordinates are (c0, c1) pairs), None = infinity.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(__file__)
+_SRC = os.path.join(_HERE, "bn254.cc")
+_LIB = os.path.join(_HERE, "libbn254.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> str | None:
+    try:
+        if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(
+            _SRC
+        ):
+            return _LIB
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-o", _LIB, _SRC],
+            check=True,
+            capture_output=True,
+            timeout=300,
+        )
+        return _LIB
+    except Exception:
+        return None
+
+
+def load():
+    """The ctypes library, or None when unavailable. Thread-safe, cached."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        path = _build()
+        if path is None:
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+            lib.bn254_native_version.restype = ctypes.c_int
+            if lib.bn254_native_version() != 1:
+                return None
+            _lib = lib
+        except OSError:
+            return None
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+# -- marshalling helpers ----------------------------------------------------
+
+
+def _i2b(x: int) -> bytes:
+    # scalars cross the ABI unreduced (any 256-bit value): [R]P must give
+    # infinity for the subgroup check, so reducing mod R here would be wrong
+    if not 0 <= x < (1 << 256):
+        raise ValueError("scalar out of 256-bit range")
+    return int(x).to_bytes(32, "little")
+
+
+def _b2i(b: bytes) -> int:
+    return int.from_bytes(b, "little")
+
+
+def _g1_buf(p) -> tuple[bytes, int]:
+    if p is None:
+        return b"\x00" * 64, 1
+    return _i2b(p[0]) + _i2b(p[1]), 0
+
+
+def _g1_out(buf, inf) -> tuple | None:
+    if inf.value:
+        return None
+    raw = bytes(buf)
+    return (_b2i(raw[:32]), _b2i(raw[32:64]))
+
+
+def _g2_buf(p) -> tuple[bytes, int]:
+    if p is None:
+        return b"\x00" * 128, 1
+    (x0, x1), (y0, y1) = p
+    return _i2b(x0) + _i2b(x1) + _i2b(y0) + _i2b(y1), 0
+
+
+def _g2_out(buf, inf) -> tuple | None:
+    if inf.value:
+        return None
+    raw = bytes(buf)
+    return (
+        (_b2i(raw[:32]), _b2i(raw[32:64])),
+        (_b2i(raw[64:96]), _b2i(raw[96:128])),
+    )
+
+
+# -- public ops (native if possible, oracle fallback) -----------------------
+
+
+def g1_add(a, b):
+    lib = load()
+    if lib is None:
+        from handel_tpu.ops import bn254_ref as bn
+
+        return bn.g1_add(a, b)
+    abuf, ainf = _g1_buf(a)
+    bbuf, binf = _g1_buf(b)
+    out = ctypes.create_string_buffer(64)
+    oinf = ctypes.c_int()
+    lib.bn254_g1_add(out, ctypes.byref(oinf), abuf, ainf, bbuf, binf)
+    return _g1_out(out, oinf)
+
+
+def g1_mul(p, k: int):
+    lib = load()
+    if lib is None:
+        from handel_tpu.ops import bn254_ref as bn
+
+        return bn.g1_mul(p, k)
+    pbuf, pinf = _g1_buf(p)
+    out = ctypes.create_string_buffer(64)
+    oinf = ctypes.c_int()
+    lib.bn254_g1_mul(out, ctypes.byref(oinf), pbuf, pinf, _i2b(k))
+    return _g1_out(out, oinf)
+
+
+def g2_add(a, b):
+    lib = load()
+    if lib is None:
+        from handel_tpu.ops import bn254_ref as bn
+
+        return bn.g2_add(a, b)
+    abuf, ainf = _g2_buf(a)
+    bbuf, binf = _g2_buf(b)
+    out = ctypes.create_string_buffer(128)
+    oinf = ctypes.c_int()
+    lib.bn254_g2_add(out, ctypes.byref(oinf), abuf, ainf, bbuf, binf)
+    return _g2_out(out, oinf)
+
+
+def g2_mul(p, k: int):
+    lib = load()
+    if lib is None:
+        from handel_tpu.ops import bn254_ref as bn
+
+        return bn.g2_mul(p, k)
+    pbuf, pinf = _g2_buf(p)
+    out = ctypes.create_string_buffer(128)
+    oinf = ctypes.c_int()
+    lib.bn254_g2_mul(out, ctypes.byref(oinf), pbuf, pinf, _i2b(k))
+    return _g2_out(out, oinf)
+
+
+def g1_mul_batch(points, scalars):
+    """n independent [k_i]P_i in one native call."""
+    lib = load()
+    from handel_tpu.ops import bn254_ref as bn
+
+    if lib is None:
+        return [bn.g1_mul(p, k) for p, k in zip(points, scalars)]
+    n = len(points)
+    pts = b"".join(_g1_buf(p)[0] for p in points)
+    infs = (ctypes.c_int * n)(*[1 if p is None else 0 for p in points])
+    ks = b"".join(_i2b(k) for k in scalars)
+    out = ctypes.create_string_buffer(64 * n)
+    oinf = (ctypes.c_int * n)()
+    lib.bn254_g1_mul_batch(out, oinf, pts, infs, ks, n)
+    raw = bytes(out)
+    return [
+        None
+        if oinf[i]
+        else (_b2i(raw[64 * i : 64 * i + 32]), _b2i(raw[64 * i + 32 : 64 * i + 64]))
+        for i in range(n)
+    ]
+
+
+def g2_mul_batch(points, scalars):
+    lib = load()
+    from handel_tpu.ops import bn254_ref as bn
+
+    if lib is None:
+        return [bn.g2_mul(p, k) for p, k in zip(points, scalars)]
+    n = len(points)
+    pts = b"".join(_g2_buf(p)[0] for p in points)
+    infs = (ctypes.c_int * n)(*[1 if p is None else 0 for p in points])
+    ks = b"".join(_i2b(k) for k in scalars)
+    out = ctypes.create_string_buffer(128 * n)
+    oinf = (ctypes.c_int * n)()
+    lib.bn254_g2_mul_batch(out, oinf, pts, infs, ks, n)
+    raw = bytes(out)
+    res = []
+    for i in range(n):
+        if oinf[i]:
+            res.append(None)
+            continue
+        o = raw[128 * i : 128 * (i + 1)]
+        res.append(
+            (
+                (_b2i(o[:32]), _b2i(o[32:64])),
+                (_b2i(o[64:96]), _b2i(o[96:128])),
+            )
+        )
+    return res
+
+
+def g1_sum(points):
+    lib = load()
+    if lib is None:
+        from handel_tpu.ops import bn254_ref as bn
+
+        acc = None
+        for p in points:
+            acc = bn.g1_add(acc, p)
+        return acc
+    n = len(points)
+    pts = b"".join(_g1_buf(p)[0] for p in points)
+    infs = (ctypes.c_int * n)(*[1 if p is None else 0 for p in points])
+    out = ctypes.create_string_buffer(64)
+    oinf = ctypes.c_int()
+    lib.bn254_g1_sum(out, ctypes.byref(oinf), pts, infs, n)
+    return _g1_out(out, oinf)
+
+
+def g2_sum(points):
+    lib = load()
+    if lib is None:
+        from handel_tpu.ops import bn254_ref as bn
+
+        acc = None
+        for p in points:
+            acc = bn.g2_add(acc, p)
+        return acc
+    n = len(points)
+    pts = b"".join(_g2_buf(p)[0] for p in points)
+    infs = (ctypes.c_int * n)(*[1 if p is None else 0 for p in points])
+    out = ctypes.create_string_buffer(128)
+    oinf = ctypes.c_int()
+    lib.bn254_g2_sum(out, ctypes.byref(oinf), pts, infs, n)
+    return _g2_out(out, oinf)

@@ -1,0 +1,528 @@
+// Host-native BN254 group arithmetic — the framework's C++ fast path.
+//
+// Role: the reference gets host-speed field arithmetic from the amd64/arm64
+// assembly inside its cloudflare/bn256 dependency (SURVEY.md §2.2); this
+// library is the equivalent native layer for the host side of the TPU build:
+// keygen, signing, point aggregation, and registry construction at
+// 4000-node simulation scale, where the pure-Python scalar oracle
+// (ops/bn254_ref.py) is orders of magnitude too slow. Device verification
+// stays on the JAX/Pallas path (ops/); this code never does pairings.
+//
+// Design: 4x64-bit limb Montgomery arithmetic (CIOS with __uint128_t),
+// Jacobian coordinates for G1 (over Fp, y^2 = x^3 + 3) and G2 (over Fp2 on
+// the twist, y^2 = x^3 + b'), double-and-add scalar multiplication.
+// Exposed as a flat C ABI for ctypes (handel_tpu/native/__init__.py):
+// points cross the boundary as 32-byte little-endian affine coordinates
+// plus an infinity flag; scalars as 32-byte little-endian.
+//
+// Correctness oracle: ops/bn254_ref.py (g1_add/g2_add/g1_mul/g2_mul);
+// cross-checked in tests/test_native.py.
+
+#include <cstdint>
+#include <cstring>
+
+using u64 = uint64_t;
+using u128 = __uint128_t;
+
+namespace {
+
+// ---- Fp: 4x64 Montgomery ----------------------------------------------
+
+struct Fp {
+  u64 v[4];
+};
+
+static const Fp P = {{0x3c208c16d87cfd47ULL, 0x97816a916871ca8dULL,
+                      0xb85045b68181585dULL, 0x30644e72e131a029ULL}};
+static const u64 N0 = 0x87d20782e4866389ULL;  // -p^{-1} mod 2^64
+static const Fp R2 = {{0xf32cfc5b538afa89ULL, 0xb5e71911d44501fbULL,
+                       0x47ab1eff0a417ff6ULL, 0x6d89f71cab8351fULL}};
+static const Fp ONE_M = {{0xd35d438dc58f0d9dULL, 0xa78eb28f5c70b3dULL,
+                          0x666ea36f7879462cULL, 0xe0a77c19a07df2fULL}};
+
+static inline bool ge_p(const Fp &a) {
+  for (int i = 3; i >= 0; --i) {
+    if (a.v[i] > P.v[i]) return true;
+    if (a.v[i] < P.v[i]) return false;
+  }
+  return true;  // equal
+}
+
+static inline void sub_p(Fp &a) {
+  u128 borrow = 0;
+  for (int i = 0; i < 4; ++i) {
+    u128 d = (u128)a.v[i] - P.v[i] - borrow;
+    a.v[i] = (u64)d;
+    borrow = (d >> 64) ? 1 : 0;
+  }
+}
+
+static inline void fp_add(Fp &out, const Fp &a, const Fp &b) {
+  u128 carry = 0;
+  bool overflow = false;
+  for (int i = 0; i < 4; ++i) {
+    u128 s = (u128)a.v[i] + b.v[i] + carry;
+    out.v[i] = (u64)s;
+    carry = s >> 64;
+  }
+  overflow = carry != 0;
+  if (overflow || ge_p(out)) sub_p(out);
+}
+
+static inline void fp_sub(Fp &out, const Fp &a, const Fp &b) {
+  u128 borrow = 0;
+  for (int i = 0; i < 4; ++i) {
+    u128 d = (u128)a.v[i] - b.v[i] - borrow;
+    out.v[i] = (u64)d;
+    borrow = (d >> 64) ? 1 : 0;
+  }
+  if (borrow) {  // add p back
+    u128 carry = 0;
+    for (int i = 0; i < 4; ++i) {
+      u128 s = (u128)out.v[i] + P.v[i] + carry;
+      out.v[i] = (u64)s;
+      carry = s >> 64;
+    }
+  }
+}
+
+static inline void fp_neg(Fp &out, const Fp &a) {
+  bool zero = !(a.v[0] | a.v[1] | a.v[2] | a.v[3]);
+  if (zero) {
+    out = a;
+    return;
+  }
+  u128 borrow = 0;
+  for (int i = 0; i < 4; ++i) {
+    u128 d = (u128)P.v[i] - a.v[i] - borrow;
+    out.v[i] = (u64)d;
+    borrow = (d >> 64) ? 1 : 0;
+  }
+}
+
+// CIOS Montgomery multiplication: out = a * b * R^{-1} mod p
+static inline void fp_mul(Fp &out, const Fp &a, const Fp &b) {
+  u64 t[6] = {0, 0, 0, 0, 0, 0};
+  for (int i = 0; i < 4; ++i) {
+    // t += a[i] * b
+    u128 carry = 0;
+    for (int j = 0; j < 4; ++j) {
+      u128 s = (u128)a.v[i] * b.v[j] + t[j] + carry;
+      t[j] = (u64)s;
+      carry = s >> 64;
+    }
+    u128 s4 = (u128)t[4] + carry;
+    t[4] = (u64)s4;
+    t[5] = (u64)(s4 >> 64);
+    // reduce: m = t[0] * n0 mod 2^64; t += m * p; t >>= 64
+    u64 m = t[0] * N0;
+    carry = ((u128)m * P.v[0] + t[0]) >> 64;
+    for (int j = 1; j < 4; ++j) {
+      u128 s = (u128)m * P.v[j] + t[j] + carry;
+      t[j - 1] = (u64)s;
+      carry = s >> 64;
+    }
+    u128 s5 = (u128)t[4] + carry;
+    t[3] = (u64)s5;
+    t[4] = t[5] + (u64)(s5 >> 64);
+    t[5] = 0;
+  }
+  out.v[0] = t[0];
+  out.v[1] = t[1];
+  out.v[2] = t[2];
+  out.v[3] = t[3];
+  if (t[4] || ge_p(out)) sub_p(out);
+}
+
+static inline void fp_sqr(Fp &out, const Fp &a) { fp_mul(out, a, a); }
+
+static inline bool fp_is_zero(const Fp &a) {
+  return !(a.v[0] | a.v[1] | a.v[2] | a.v[3]);
+}
+
+static inline void fp_to_mont(Fp &out, const Fp &a) { fp_mul(out, a, R2); }
+
+static inline void fp_from_mont(Fp &out, const Fp &a) {
+  Fp one = {{1, 0, 0, 0}};
+  fp_mul(out, a, one);
+}
+
+// a^e by square-and-multiply (e not secret here: public curve math)
+static void fp_pow(Fp &out, const Fp &a, const Fp &e) {
+  Fp acc = ONE_M;
+  for (int i = 3; i >= 0; --i) {
+    for (int b = 63; b >= 0; --b) {
+      fp_sqr(acc, acc);
+      if ((e.v[i] >> b) & 1) fp_mul(acc, acc, a);
+    }
+  }
+  out = acc;
+}
+
+static void fp_inv(Fp &out, const Fp &a) {
+  // Fermat: a^(p-2)
+  Fp e = P;
+  u128 borrow = 2;
+  for (int i = 0; i < 4 && borrow; ++i) {
+    u128 d = (u128)e.v[i] - borrow;
+    e.v[i] = (u64)d;
+    borrow = (d >> 64) ? 1 : 0;
+  }
+  fp_pow(out, a, e);
+}
+
+// ---- Fp2 = Fp[i]/(i^2+1) ----------------------------------------------
+
+struct Fp2 {
+  Fp c0, c1;
+};
+
+static inline void f2_add(Fp2 &o, const Fp2 &a, const Fp2 &b) {
+  fp_add(o.c0, a.c0, b.c0);
+  fp_add(o.c1, a.c1, b.c1);
+}
+static inline void f2_sub(Fp2 &o, const Fp2 &a, const Fp2 &b) {
+  fp_sub(o.c0, a.c0, b.c0);
+  fp_sub(o.c1, a.c1, b.c1);
+}
+static inline void f2_neg(Fp2 &o, const Fp2 &a) {
+  fp_neg(o.c0, a.c0);
+  fp_neg(o.c1, a.c1);
+}
+static inline void f2_mul(Fp2 &o, const Fp2 &a, const Fp2 &b) {
+  Fp t0, t1, t2, t3;
+  fp_mul(t0, a.c0, b.c0);
+  fp_mul(t1, a.c1, b.c1);
+  fp_add(t2, a.c0, a.c1);
+  fp_add(t3, b.c0, b.c1);
+  fp_mul(t2, t2, t3);  // (a0+a1)(b0+b1)
+  Fp r0;
+  fp_sub(r0, t0, t1);  // a0b0 - a1b1
+  fp_sub(t2, t2, t0);
+  fp_sub(t2, t2, t1);  // cross
+  o.c0 = r0;
+  o.c1 = t2;
+}
+static inline void f2_sqr(Fp2 &o, const Fp2 &a) { f2_mul(o, a, a); }
+static inline bool f2_is_zero(const Fp2 &a) {
+  return fp_is_zero(a.c0) && fp_is_zero(a.c1);
+}
+static void f2_inv(Fp2 &o, const Fp2 &a) {
+  // 1/(c0 + c1 i) = (c0 - c1 i) / (c0^2 + c1^2)
+  Fp n, t0, t1;
+  fp_sqr(t0, a.c0);
+  fp_sqr(t1, a.c1);
+  fp_add(n, t0, t1);
+  fp_inv(n, n);
+  fp_mul(o.c0, a.c0, n);
+  Fp neg;
+  fp_neg(neg, a.c1);
+  fp_mul(o.c1, neg, n);
+}
+
+// ---- generic Jacobian curve ops over a field F -------------------------
+// (X, Y, Z): x = X/Z^2, y = Y/Z^3; infinity: Z == 0.
+
+template <typename F>
+struct CurveOps {
+  void (*add)(F &, const F &, const F &);
+  void (*sub)(F &, const F &, const F &);
+  void (*mul)(F &, const F &, const F &);
+  void (*sqr)(F &, const F &);
+  void (*neg)(F &, const F &);
+  void (*inv)(F &, const F &);
+  bool (*is_zero)(const F &);
+  F b;  // curve coefficient (Montgomery form)
+};
+
+template <typename F>
+struct Jac {
+  F X, Y, Z;
+  bool inf;
+};
+
+template <typename F>
+static void jac_double(const CurveOps<F> &ops, Jac<F> &o, const Jac<F> &p) {
+  if (p.inf || ops.is_zero(p.Y)) {
+    o.inf = true;
+    return;
+  }
+  // alias-safe: o may be the same object as p, so everything is computed
+  // into locals and assigned at the end
+  F A, B, C, D, t0, t1, X3, Y3, Z3;
+  ops.sqr(A, p.X);              // X^2
+  ops.sqr(B, p.Y);              // Y^2
+  ops.sqr(C, B);                // Y^4
+  ops.add(t0, p.X, B);
+  ops.sqr(t0, t0);
+  ops.sub(t0, t0, A);
+  ops.sub(t0, t0, C);
+  ops.add(D, t0, t0);           // D = 2((X+B)^2 - A - C)
+  ops.add(t0, A, A);
+  ops.add(t0, t0, A);           // E = 3A
+  F E = t0;
+  ops.sqr(t1, E);               // E^2
+  ops.sub(t1, t1, D);
+  ops.sub(X3, t1, D);           // X3 = E^2 - 2D
+  ops.sub(t1, D, X3);
+  ops.mul(t1, E, t1);
+  F c8;
+  ops.add(c8, C, C);
+  ops.add(c8, c8, c8);
+  ops.add(c8, c8, c8);          // 8C
+  ops.sub(Y3, t1, c8);
+  ops.mul(t1, p.Y, p.Z);
+  ops.add(Z3, t1, t1);          // Z3 = 2YZ
+  o.X = X3;
+  o.Y = Y3;
+  o.Z = Z3;
+  o.inf = false;
+}
+
+template <typename F>
+static void jac_add(const CurveOps<F> &ops, Jac<F> &o, const Jac<F> &p,
+                    const Jac<F> &q) {
+  if (p.inf) {
+    o = q;
+    return;
+  }
+  if (q.inf) {
+    o = p;
+    return;
+  }
+  F Z1Z1, Z2Z2, U1, U2, S1, S2, t0;
+  ops.sqr(Z1Z1, p.Z);
+  ops.sqr(Z2Z2, q.Z);
+  ops.mul(U1, p.X, Z2Z2);
+  ops.mul(U2, q.X, Z1Z1);
+  ops.mul(t0, q.Z, Z2Z2);
+  ops.mul(S1, p.Y, t0);
+  ops.mul(t0, p.Z, Z1Z1);
+  ops.mul(S2, q.Y, t0);
+  F H, Rr;
+  ops.sub(H, U2, U1);
+  ops.sub(Rr, S2, S1);
+  if (ops.is_zero(H)) {
+    if (ops.is_zero(Rr)) {
+      jac_double(ops, o, p);
+      return;
+    }
+    o.inf = true;
+    return;
+  }
+  // alias-safe: o may be p or q; compute into locals, assign at the end
+  F HH, HHH, V, X3, Y3, Z3;
+  ops.sqr(HH, H);
+  ops.mul(HHH, H, HH);
+  ops.mul(V, U1, HH);
+  ops.sqr(X3, Rr);
+  ops.sub(X3, X3, HHH);
+  ops.sub(X3, X3, V);
+  ops.sub(X3, X3, V);
+  ops.sub(t0, V, X3);
+  ops.mul(t0, Rr, t0);
+  F t1;
+  ops.mul(t1, S1, HHH);
+  ops.sub(Y3, t0, t1);
+  ops.mul(t0, p.Z, q.Z);
+  ops.mul(Z3, t0, H);
+  o.X = X3;
+  o.Y = Y3;
+  o.Z = Z3;
+  o.inf = false;
+}
+
+template <typename F>
+static void jac_mul(const CurveOps<F> &ops, Jac<F> &o, const Jac<F> &p,
+                    const u64 k[4]) {
+  Jac<F> acc;
+  acc.inf = true;
+  bool started = false;
+  for (int i = 3; i >= 0; --i) {
+    for (int b = 63; b >= 0; --b) {
+      if (started) jac_double(ops, acc, acc);
+      if ((k[i] >> b) & 1) {
+        if (acc.inf)
+          acc = p;
+        else
+          jac_add(ops, acc, acc, p);
+        started = true;
+      } else if (!started) {
+        continue;
+      }
+    }
+  }
+  o = acc;
+}
+
+template <typename F>
+static void jac_to_affine(const CurveOps<F> &ops, F &x, F &y, bool &inf,
+                          const Jac<F> &p) {
+  if (p.inf || ops.is_zero(p.Z)) {
+    inf = true;
+    return;
+  }
+  F zi, zi2, zi3;
+  ops.inv(zi, p.Z);
+  ops.sqr(zi2, zi);
+  ops.mul(zi3, zi2, zi);
+  ops.mul(x, p.X, zi2);
+  ops.mul(y, p.Y, zi3);
+  inf = false;
+}
+
+// instantiate for Fp and Fp2
+static const CurveOps<Fp> G1OPS = {fp_add, fp_sub, fp_mul, fp_sqr,
+                                   fp_neg, fp_inv, fp_is_zero, Fp{}};
+static const CurveOps<Fp2> G2OPS = {f2_add, f2_sub, f2_mul, f2_sqr,
+                                    f2_neg, f2_inv, f2_is_zero, Fp2{}};
+
+// ---- byte-buffer marshalling -------------------------------------------
+
+static void load_fp(Fp &out, const uint8_t *b) {
+  Fp raw;
+  std::memcpy(raw.v, b, 32);  // little-endian limbs
+  fp_to_mont(out, raw);
+}
+
+static void store_fp(uint8_t *b, const Fp &a) {
+  Fp raw;
+  fp_from_mont(raw, a);
+  std::memcpy(b, raw.v, 32);
+}
+
+static void load_g1(Jac<Fp> &p, const uint8_t *xy, int inf) {
+  p.inf = inf != 0;
+  if (p.inf) return;
+  load_fp(p.X, xy);
+  load_fp(p.Y, xy + 32);
+  p.Z = ONE_M;
+}
+
+static void store_g1(uint8_t *xy, int *inf, const Jac<Fp> &p) {
+  Fp x, y;
+  bool isinf;
+  jac_to_affine(G1OPS, x, y, isinf, p);
+  *inf = isinf ? 1 : 0;
+  if (!isinf) {
+    store_fp(xy, x);
+    store_fp(xy + 32, y);
+  } else {
+    std::memset(xy, 0, 64);
+  }
+}
+
+static void load_g2(Jac<Fp2> &p, const uint8_t *xy, int inf) {
+  p.inf = inf != 0;
+  if (p.inf) return;
+  load_fp(p.X.c0, xy);
+  load_fp(p.X.c1, xy + 32);
+  load_fp(p.Y.c0, xy + 64);
+  load_fp(p.Y.c1, xy + 96);
+  p.Z.c0 = ONE_M;
+  std::memset(p.Z.c1.v, 0, 32);
+}
+
+static void store_g2(uint8_t *xy, int *inf, const Jac<Fp2> &p) {
+  Fp2 x, y;
+  bool isinf;
+  jac_to_affine(G2OPS, x, y, isinf, p);
+  *inf = isinf ? 1 : 0;
+  if (!isinf) {
+    store_fp(xy, x.c0);
+    store_fp(xy + 32, x.c1);
+    store_fp(xy + 64, y.c0);
+    store_fp(xy + 96, y.c1);
+  } else {
+    std::memset(xy, 0, 128);
+  }
+}
+
+}  // namespace
+
+// ---- C ABI --------------------------------------------------------------
+
+extern "C" {
+
+// G1 points: 64-byte affine (x ‖ y), scalars: 32-byte little-endian.
+void bn254_g1_add(uint8_t *out, int *out_inf, const uint8_t *a, int a_inf,
+                  const uint8_t *b, int b_inf) {
+  Jac<Fp> P1, P2, S;
+  load_g1(P1, a, a_inf);
+  load_g1(P2, b, b_inf);
+  jac_add(G1OPS, S, P1, P2);
+  store_g1(out, out_inf, S);
+}
+
+void bn254_g1_mul(uint8_t *out, int *out_inf, const uint8_t *a, int a_inf,
+                  const uint8_t *scalar) {
+  Jac<Fp> P1, S;
+  load_g1(P1, a, a_inf);
+  u64 k[4];
+  std::memcpy(k, scalar, 32);
+  jac_mul(G1OPS, S, P1, k);
+  store_g1(out, out_inf, S);
+}
+
+// G2 points: 128-byte affine (x0 ‖ x1 ‖ y0 ‖ y1).
+void bn254_g2_add(uint8_t *out, int *out_inf, const uint8_t *a, int a_inf,
+                  const uint8_t *b, int b_inf) {
+  Jac<Fp2> P1, P2, S;
+  load_g2(P1, a, a_inf);
+  load_g2(P2, b, b_inf);
+  jac_add(G2OPS, S, P1, P2);
+  store_g2(out, out_inf, S);
+}
+
+void bn254_g2_mul(uint8_t *out, int *out_inf, const uint8_t *a, int a_inf,
+                  const uint8_t *scalar) {
+  Jac<Fp2> P1, S;
+  load_g2(P1, a, a_inf);
+  u64 k[4];
+  std::memcpy(k, scalar, 32);
+  jac_mul(G2OPS, S, P1, k);
+  store_g2(out, out_inf, S);
+}
+
+// Batch multi-scalar entry points: n independent muls in one call
+// (amortizes the ctypes crossing for registry-scale keygen).
+void bn254_g1_mul_batch(uint8_t *out, int *out_inf, const uint8_t *pts,
+                        const int *infs, const uint8_t *scalars, int n) {
+  for (int i = 0; i < n; ++i)
+    bn254_g1_mul(out + 64 * i, out_inf + i, pts + 64 * i, infs[i],
+                 scalars + 32 * i);
+}
+
+void bn254_g2_mul_batch(uint8_t *out, int *out_inf, const uint8_t *pts,
+                        const int *infs, const uint8_t *scalars, int n) {
+  for (int i = 0; i < n; ++i)
+    bn254_g2_mul(out + 128 * i, out_inf + i, pts + 128 * i, infs[i],
+                 scalars + 32 * i);
+}
+
+// Sum of n G1 points (the host-side Combine fallback when no device).
+void bn254_g1_sum(uint8_t *out, int *out_inf, const uint8_t *pts,
+                  const int *infs, int n) {
+  Jac<Fp> acc, Q;
+  acc.inf = true;
+  for (int i = 0; i < n; ++i) {
+    load_g1(Q, pts + 64 * i, infs[i]);
+    jac_add(G1OPS, acc, acc, Q);
+  }
+  store_g1(out, out_inf, acc);
+}
+
+void bn254_g2_sum(uint8_t *out, int *out_inf, const uint8_t *pts,
+                  const int *infs, int n) {
+  Jac<Fp2> acc, Q;
+  acc.inf = true;
+  for (int i = 0; i < n; ++i) {
+    load_g2(Q, pts + 128 * i, infs[i]);
+    jac_add(G2OPS, acc, acc, Q);
+  }
+  store_g2(out, out_inf, acc);
+}
+
+int bn254_native_version() { return 1; }
+
+}  // extern "C"

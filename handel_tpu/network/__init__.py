@@ -18,6 +18,7 @@ from handel_tpu.network.encoding import (
 )
 from handel_tpu.network.udp import UDPNetwork
 from handel_tpu.network.tcp import TCPNetwork
+from handel_tpu.network.quic import QUICNetwork
 
 __all__ = [
     "Encoding",
@@ -25,4 +26,5 @@ __all__ = [
     "CounterEncoding",
     "UDPNetwork",
     "TCPNetwork",
+    "QUICNetwork",
 ]

@@ -1,0 +1,222 @@
+"""Secure session transport — the QUIC slot of the transport matrix.
+
+Reference: network/quic/net.go:22-139 (session-per-peer transport with a TLS
+config), sessionmanager.go:11-93 (session cache + dedup of concurrent dials
+to the same peer via an isWaiting set), dialer.go (pluggable dialer), and
+config.go:14-71 (`NewInsecureTestConfig` — self-signed cert, verification
+skipped).
+
+No QUIC stack is available in this environment, so the same component is
+built on TLS-over-TCP: what the reference gets from QUIC (an authenticated,
+encrypted, session-oriented channel with cheap per-peer session reuse) maps
+to cached TLS streams; the session manager, dialer seam, and insecure test
+config are ported semantically. Packets are length-prefixed on the stream
+like network/tcp.py.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import ssl
+import tempfile
+from typing import Callable, Sequence
+
+from handel_tpu.core.logging import DEFAULT_LOGGER, Logger
+from handel_tpu.core.net import Listener, Packet
+from handel_tpu.network.encoding import BinaryEncoding, Encoding
+from handel_tpu.network.stream import TaskSet, frame, read_frames
+from handel_tpu.network.udp import split_addr
+
+
+def new_insecure_test_config() -> tuple[ssl.SSLContext, ssl.SSLContext]:
+    """(server_ctx, client_ctx) with a fresh self-signed certificate and
+    client verification disabled (quic/config.go:14-71
+    `NewInsecureTestConfig`). Test/simulation use only."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+    import datetime
+
+    key = ec.generate_private_key(ec.SECP256R1())
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, "handel-tpu")])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=1))
+        .sign(key, hashes.SHA256())
+    )
+    with tempfile.NamedTemporaryFile(suffix=".pem", delete=False) as f:
+        f.write(cert.public_bytes(serialization.Encoding.PEM))
+        f.write(
+            key.private_bytes(
+                serialization.Encoding.PEM,
+                serialization.PrivateFormat.PKCS8,
+                serialization.NoEncryption(),
+            )
+        )
+        path = f.name
+    server_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    try:
+        server_ctx.load_cert_chain(path)
+    finally:
+        os.unlink(path)  # key material must not linger on disk
+    client_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    client_ctx.check_hostname = False
+    client_ctx.verify_mode = ssl.CERT_NONE
+    return server_ctx, client_ctx
+
+
+class _Session:
+    """One live outbound session (a TLS stream to a peer)."""
+
+    def __init__(self, writer: asyncio.StreamWriter):
+        self.writer = writer
+
+    def alive(self) -> bool:
+        return not self.writer.is_closing()
+
+    def close(self) -> None:
+        self.writer.close()
+
+
+class SessionManager:
+    """Per-peer session cache that dedups concurrent dials
+    (quic/sessionmanager.go:11-93 `simpleSesssionManager`): while a dial to a
+    peer is in flight, other senders await the same future instead of opening
+    a second session."""
+
+    def __init__(self, dialer: Callable):
+        self._dialer = dialer  # async addr -> _Session
+        self._sessions: dict[str, _Session] = {}
+        self._waiting: dict[str, asyncio.Future] = {}  # isWaiting set
+
+    async def session(self, addr: str) -> _Session:
+        ses = self._sessions.get(addr)
+        if ses is not None and ses.alive():
+            return ses
+        fut = self._waiting.get(addr)
+        if fut is not None:  # a dial is already in flight: piggyback
+            return await asyncio.shield(fut)
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        self._waiting[addr] = fut
+        try:
+            ses = await self._dialer(addr)
+        except BaseException as e:
+            fut.set_exception(e)
+            # consume the exception if nobody else awaited the future
+            fut.exception()
+            raise
+        finally:
+            self._waiting.pop(addr, None)
+        if not fut.done():
+            fut.set_result(ses)
+        self._sessions[addr] = ses
+        return ses
+
+    def drop(self, addr: str) -> None:
+        ses = self._sessions.pop(addr, None)
+        if ses is not None:
+            ses.close()
+
+    def close_all(self) -> None:
+        for addr in list(self._sessions):
+            self.drop(addr)
+
+
+class QUICNetwork:
+    """Session-oriented secure Network (network/quic/net.go:22-139).
+
+    `server_ctx`/`client_ctx` default to the insecure test config; pass real
+    SSL contexts for deployment."""
+
+    def __init__(
+        self,
+        listen_addr: str,
+        encoding: Encoding | None = None,
+        logger: Logger = DEFAULT_LOGGER,
+        server_ctx: ssl.SSLContext | None = None,
+        client_ctx: ssl.SSLContext | None = None,
+    ):
+        self.listen_addr = listen_addr
+        self.enc = encoding or BinaryEncoding()
+        self.log = logger
+        self.listeners: list[Listener] = []
+        if server_ctx is None or client_ctx is None:
+            server_ctx, client_ctx = new_insecure_test_config()
+        self._server_ctx = server_ctx
+        self._client_ctx = client_ctx
+        self._server: asyncio.Server | None = None
+        self.sessions = SessionManager(self._dial)
+        self._tasks = TaskSet()
+        self.sent = 0
+        self.rcvd = 0
+
+    async def start(self) -> None:
+        host, port = split_addr(self.listen_addr)
+        self._server = await asyncio.start_server(
+            self._handle_conn, "0.0.0.0", port, ssl=self._server_ctx
+        )
+
+    def stop(self) -> None:
+        if self._server:
+            self._server.close()
+        self._tasks.cancel_all()
+        self.sessions.close_all()
+
+    # -- dialer seam (quic/dialer.go) ---------------------------------------
+
+    async def _dial(self, addr: str) -> _Session:
+        host, port = split_addr(addr)
+        _, writer = await asyncio.open_connection(
+            host, port, ssl=self._client_ctx
+        )
+        return _Session(writer)
+
+    # -- inbound ------------------------------------------------------------
+
+    async def _handle_conn(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        def count():
+            self.rcvd += 1
+
+        try:
+            await read_frames(
+                reader, self.enc, self.listeners, self.log, "quic", count
+            )
+        finally:
+            writer.close()
+
+    # -- outbound -----------------------------------------------------------
+
+    def send(self, identities: Sequence["Identity"], packet: Packet) -> None:  # noqa: F821
+        framed = frame(self.enc.encode(packet))
+        for ident in identities:
+            self._tasks.spawn(self._send_to(ident.address, framed))
+
+    async def _send_to(self, addr: str, framed: bytes) -> None:
+        try:
+            ses = await self.sessions.session(addr)
+            ses.writer.write(framed)
+            await ses.writer.drain()
+            self.sent += 1
+        except (OSError, ssl.SSLError) as e:
+            self.log.warn("quic_send", f"{addr}: {e}")
+            self.sessions.drop(addr)
+
+    def register_listener(self, listener: Listener) -> None:
+        self.listeners.append(listener)
+
+    def values(self) -> dict[str, float]:
+        out = {"sentPackets": float(self.sent), "rcvdPackets": float(self.rcvd)}
+        if hasattr(self.enc, "values"):
+            out.update(self.enc.values())
+        return out

@@ -1,0 +1,60 @@
+"""Shared machinery for stream transports (TCP and the TLS/QUIC slot).
+
+One place for the length-prefixed frame protocol (uint32 header + encoded
+packet — TCP gives no message boundaries), the inbound read loop, and the
+background-send task registry (asyncio keeps only weak refs to tasks, so a
+fire-and-forget `create_task` can be garbage-collected mid-await; senders
+must hold strong refs until completion).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+
+_LEN = struct.Struct(">I")
+IDLE_TIMEOUT = 60.0  # reference's 1-minute conn deadline (tcp/net.go:100)
+
+
+def frame(wire: bytes) -> bytes:
+    return _LEN.pack(len(wire)) + wire
+
+
+async def read_frames(reader, enc, listeners, log, tag: str, on_packet=None):
+    """Length-prefixed read loop shared by every stream transport: decode
+    each frame and fan out to listeners until EOF/idle-timeout/error."""
+    try:
+        while True:
+            hdr = await asyncio.wait_for(
+                reader.readexactly(_LEN.size), IDLE_TIMEOUT
+            )
+            (size,) = _LEN.unpack(hdr)
+            data = await reader.readexactly(size)
+            try:
+                packet = enc.decode(data)
+            except Exception as e:
+                log.warn(f"{tag}_decode", e)
+                continue
+            if on_packet is not None:
+                on_packet()
+            for lst in listeners:
+                lst.new_packet(packet)
+    except (asyncio.IncompleteReadError, asyncio.TimeoutError, OSError):
+        pass
+
+
+class TaskSet:
+    """Strong-reference holder for fire-and-forget send tasks."""
+
+    def __init__(self):
+        self._tasks: set[asyncio.Task] = set()
+
+    def spawn(self, coro) -> asyncio.Task:
+        task = asyncio.get_running_loop().create_task(coro)
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+        return task
+
+    def cancel_all(self) -> None:
+        for t in list(self._tasks):
+            t.cancel()

@@ -5,23 +5,22 @@ packet streams, lazy dial-on-send with a per-peer connection cache, and a
 1-minute idle deadline.
 
 asyncio redesign: an asyncio.Server per node; outbound writers are cached per
-peer address and dropped on error (next send re-dials). Packets on the stream
-are length-prefixed (uint32) since TCP has no message boundaries.
+peer address and dropped on error (next send re-dials). Concurrent sends to a
+not-yet-connected peer share one in-flight dial (the same dedup the reference
+gives QUIC a session manager for). Framing/read-loop/task bookkeeping live in
+network/stream.py, shared with the TLS transport.
 """
 
 from __future__ import annotations
 
 import asyncio
-import struct
 from typing import Sequence
 
 from handel_tpu.core.logging import DEFAULT_LOGGER, Logger
 from handel_tpu.core.net import Listener, Packet
 from handel_tpu.network.encoding import Encoding, BinaryEncoding
+from handel_tpu.network.stream import TaskSet, frame, read_frames
 from handel_tpu.network.udp import split_addr
-
-_LEN = struct.Struct(">I")
-IDLE_TIMEOUT = 60.0  # reference's 1-minute conn deadline (tcp/net.go:100)
 
 
 class TCPNetwork:
@@ -39,6 +38,8 @@ class TCPNetwork:
         self.listeners: list[Listener] = []
         self._server: asyncio.Server | None = None
         self._writers: dict[str, asyncio.StreamWriter] = {}
+        self._dialing: dict[str, asyncio.Future] = {}  # dedup in-flight dials
+        self._tasks = TaskSet()
         self.sent = 0
         self.rcvd = 0
 
@@ -51,6 +52,7 @@ class TCPNetwork:
     def stop(self) -> None:
         if self._server:
             self._server.close()
+        self._tasks.cancel_all()
         for w in self._writers.values():
             w.close()
         self._writers.clear()
@@ -58,44 +60,50 @@ class TCPNetwork:
     async def _handle_conn(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
+        def count():
+            self.rcvd += 1
+
         try:
-            while True:
-                hdr = await asyncio.wait_for(
-                    reader.readexactly(_LEN.size), IDLE_TIMEOUT
-                )
-                (size,) = _LEN.unpack(hdr)
-                data = await reader.readexactly(size)
-                try:
-                    packet = self.enc.decode(data)
-                except Exception as e:
-                    self.log.warn("tcp_decode", e)
-                    continue
-                self.rcvd += 1
-                for lst in self.listeners:
-                    lst.new_packet(packet)
-        except (asyncio.IncompleteReadError, asyncio.TimeoutError, OSError):
-            pass
+            await read_frames(
+                reader, self.enc, self.listeners, self.log, "tcp", count
+            )
         finally:
             writer.close()
 
     def send(self, identities: Sequence["Identity"], packet: Packet) -> None:  # noqa: F821
-        wire = self.enc.encode(packet)
-        framed = _LEN.pack(len(wire)) + wire
+        framed = frame(self.enc.encode(packet))
         for ident in identities:
-            asyncio.get_running_loop().create_task(
-                self._send_to(ident.address, framed)
-            )
+            self._tasks.spawn(self._send_to(ident.address, framed))
+
+    async def _writer_for(self, addr: str) -> asyncio.StreamWriter | None:
+        writer = self._writers.get(addr)
+        if writer is not None and not writer.is_closing():
+            return writer
+        fut = self._dialing.get(addr)
+        if fut is not None:  # piggyback on the in-flight dial
+            return await asyncio.shield(fut)
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        self._dialing[addr] = fut
+        try:
+            host, port = split_addr(addr)
+            _, writer = await asyncio.open_connection(host, port)
+        except OSError as e:
+            self.log.warn("tcp_dial", f"{addr}: {e}")
+            if not fut.done():
+                fut.set_result(None)
+            return None
+        finally:
+            self._dialing.pop(addr, None)
+        self._writers[addr] = writer
+        if not fut.done():
+            fut.set_result(writer)
+        return writer
 
     async def _send_to(self, addr: str, framed: bytes) -> None:
-        writer = self._writers.get(addr)
-        if writer is None or writer.is_closing():
-            host, port = split_addr(addr)
-            try:
-                _, writer = await asyncio.open_connection(host, port)
-            except OSError as e:
-                self.log.warn("tcp_dial", f"{addr}: {e}")
-                return
-            self._writers[addr] = writer
+        writer = await self._writer_for(addr)
+        if writer is None:
+            return
         try:
             writer.write(framed)
             await writer.drain()

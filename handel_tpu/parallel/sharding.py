@@ -116,18 +116,21 @@ def sharded_pairing_check(
     lane_sharding = NamedSharding(mesh, P(None, axis))
     mask_sharding = NamedSharding(mesh, P(axis))
 
-    def check(p, q, mask):
-        return pairing.pairing_check(p, q, mask, groups)
-
-    return jax.jit(
-        check,
-        in_shardings=(
-            ((lane_sharding, lane_sharding)),
-            (
-                (lane_sharding, lane_sharding),
-                (lane_sharding, lane_sharding),
-            ),
-            mask_sharding,
-        ),
+    jitted = jax.jit(
+        lambda p, q, mask: pairing.pairing_check(p, q, mask, groups),
         out_shardings=NamedSharding(mesh, P(axis)),
     )
+
+    def check(p, q, mask):
+        # reshard eagerly: inputs may arrive committed with a different layout
+        # (e.g. the replicated output of sharded_masked_sum_g2), and jit
+        # in_shardings refuses committed-but-mismatched args; device_put is
+        # the documented reshard path and jit then infers lane parallelism
+        # from the committed input shardings.
+        reshard = lambda a: jax.device_put(a, lane_sharding)
+        p = jax.tree_util.tree_map(reshard, p)
+        q = jax.tree_util.tree_map(reshard, q)
+        mask = jax.device_put(mask, mask_sharding)
+        return jitted(p, q, mask)
+
+    return check

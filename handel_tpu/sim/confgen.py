@@ -1,0 +1,168 @@
+"""Experiment-matrix TOML generator.
+
+Reference: simul/confgenerator/confgenerator.go:18-469 — programmatic
+generation of the paper's scenario files (node-count sweeps 100->4000,
+failing-node grids, threshold increments, update-period/timeout sweeps,
+baseline nsquare/libp2p matrices), each emitted as a simulation TOML.
+
+Each scenario function returns a SimConfig; `generate(outdir)` writes the
+whole matrix. The TPU additions ride the same knobs: scheme selects the
+device path ("bn254-jax"), `batch_size` the launch width, `shared_verifier`
+the fused many-node device service.
+"""
+
+from __future__ import annotations
+
+import os
+
+from handel_tpu.sim.config import HandelParams, RunConfig, SimConfig, dump_config
+
+# the reference's standard sweep (confgenerator.go nodesCount scenarios)
+NODE_SWEEP = [100, 300, 500, 1000, 2000, 4000]
+
+
+def _runs(nodes_list, threshold_of, failing_of=lambda n: 0, processes_of=None, **hp):
+    if processes_of is None:
+        processes_of = lambda n: max(1, n // 500)
+    return [
+        RunConfig(
+            nodes=n,
+            threshold=threshold_of(n),
+            failing=failing_of(n),
+            processes=processes_of(n),
+            handel=HandelParams(**hp),
+        )
+        for n in nodes_list
+    ]
+
+
+def scenario_node_count(scheme: str = "bn254-jax") -> SimConfig:
+    """Completion time vs committee size at 99% threshold (the headline
+    curve, confgenerator.go nodeCount scenario)."""
+    return SimConfig(
+        network="udp",
+        scheme=scheme,
+        runs=_runs(NODE_SWEEP, lambda n: n * 99 // 100),
+    )
+
+
+def scenario_threshold_inc(nodes: int = 2000) -> SimConfig:
+    """Threshold sweep 51/75/90/99% at fixed N (thresholdInc scenario)."""
+    return SimConfig(
+        network="udp",
+        scheme="bn254-jax",
+        runs=[
+            RunConfig(nodes=nodes, threshold=nodes * pct // 100,
+                      processes=max(1, nodes // 500))
+            for pct in (51, 75, 90, 99)
+        ],
+    )
+
+
+def scenario_failing(nodes: int = 4000) -> SimConfig:
+    """Failing-node grid at fixed N: up to 49% dead, threshold 51%
+    (confgenerator.go failing scenario / handel_4000_failing.csv)."""
+    return SimConfig(
+        network="udp",
+        scheme="bn254-jax",
+        runs=[
+            RunConfig(
+                nodes=nodes,
+                threshold=nodes * 51 // 100,
+                failing=f,
+                processes=max(1, nodes // 500),
+            )
+            for f in (0, nodes // 10, nodes // 4, nodes * 49 // 100)
+        ],
+    )
+
+
+def scenario_period(nodes: int = 2000) -> SimConfig:
+    """Update-period sweep (periods scenario)."""
+    return SimConfig(
+        network="udp",
+        scheme="bn254-jax",
+        runs=[
+            r
+            for ms in (10.0, 20.0, 50.0, 100.0)
+            for r in _runs([nodes], lambda n: n * 99 // 100, period_ms=ms)
+        ],
+    )
+
+
+def scenario_timeout(nodes: int = 2000) -> SimConfig:
+    """Level-timeout sweep (timeout scenario)."""
+    return SimConfig(
+        network="udp",
+        scheme="bn254-jax",
+        runs=[
+            r
+            for ms in (50.0, 100.0, 200.0, 500.0)
+            for r in _runs([nodes], lambda n: n * 99 // 100, timeout_ms=ms)
+        ],
+    )
+
+
+def scenario_nsquare() -> SimConfig:
+    """Full-diffusion gossip baseline matrix (nsquare scenario)."""
+    return SimConfig(
+        network="udp",
+        scheme="bn254",
+        baseline="nsquare",
+        runs=_runs(NODE_SWEEP[:4], lambda n: n * 51 // 100),
+    )
+
+
+def scenario_gossipsub() -> SimConfig:
+    """Mesh-gossip baseline matrix (libp2p scenario)."""
+    return SimConfig(
+        network="udp",
+        scheme="bn254",
+        baseline="gossipsub",
+        runs=_runs(NODE_SWEEP[:4], lambda n: n * 51 // 100),
+    )
+
+
+def scenario_practical(nodes: int = 4000) -> SimConfig:
+    """The README headline run: N=4000, 99% threshold, real crypto on the
+    device path with the shared verifier fusing co-located nodes' batches."""
+    return SimConfig(
+        network="udp",
+        scheme="bn254-jax",
+        shared_verifier=True,
+        batch_size=128,
+        runs=_runs([nodes], lambda n: n * 99 // 100),
+    )
+
+
+SCENARIOS = {
+    "node_count": scenario_node_count,
+    "threshold_inc": scenario_threshold_inc,
+    "failing": scenario_failing,
+    "period": scenario_period,
+    "timeout": scenario_timeout,
+    "nsquare": scenario_nsquare,
+    "gossipsub": scenario_gossipsub,
+    "practical": scenario_practical,
+}
+
+
+def generate(outdir: str, names=None) -> list[str]:
+    """Write every (or the named) scenario TOMLs; returns the paths."""
+    os.makedirs(outdir, exist_ok=True)
+    paths = []
+    for name in names or SCENARIOS:
+        cfg = SCENARIOS[name]()
+        path = os.path.join(outdir, f"{name}.toml")
+        with open(path, "w") as f:
+            f.write(dump_config(cfg))
+        paths.append(path)
+    return paths
+
+
+if __name__ == "__main__":
+    import sys
+
+    outdir = sys.argv[1] if len(sys.argv) > 1 else "configs"
+    for p in generate(outdir):
+        print(p)

@@ -70,6 +70,9 @@ class SimConfig:
     batch_size: int = 16
     shared_verifier: bool = False
     debug: bool = False
+    # "" = Handel; "nsquare" / "gossipsub" select the comparison baselines
+    # (simul/p2p; here handel_tpu/baselines/gossip.py)
+    baseline: str = ""
     runs: list[RunConfig] = field(default_factory=list)
 
 
@@ -86,6 +89,7 @@ def load_config(path: str) -> SimConfig:
         batch_size=int(raw.get("batch_size", 16)),
         shared_verifier=bool(raw.get("shared_verifier", False)),
         debug=bool(raw.get("debug", False)),
+        baseline=str(raw.get("baseline", "")),
     )
     for r in raw.get("runs", []):
         h = r.get("handel", {})
@@ -121,6 +125,7 @@ def dump_config(cfg: SimConfig) -> str:
         f"batch_size = {cfg.batch_size}",
         f"shared_verifier = {str(cfg.shared_verifier).lower()}",
         f"debug = {str(cfg.debug).lower()}",
+        f'baseline = "{cfg.baseline}"',
     ]
     for r in cfg.runs:
         lines += [

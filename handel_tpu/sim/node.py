@@ -24,6 +24,7 @@ from handel_tpu.models.registry import new_scheme
 from handel_tpu.network.encoding import CounterEncoding
 from handel_tpu.network.udp import UDPNetwork
 from handel_tpu.network.tcp import TCPNetwork
+from handel_tpu.network.quic import QUICNetwork
 from handel_tpu.sim import keys as simkeys
 from handel_tpu.sim.config import load_config
 from handel_tpu.sim.monitor import CounterIO, Sink, TimeMeasure
@@ -46,7 +47,7 @@ async def run_node_process(args) -> int:
     # one transport per logical node, bound to its registry address
     nets, handels = [], []
     shared_service = None
-    if cfg.shared_verifier and cfg.scheme.endswith("jax"):
+    if cfg.shared_verifier and cfg.scheme.endswith("jax") and not cfg.baseline:
         from handel_tpu.models.bn254_jax import BN254Device
         from handel_tpu.parallel.batch_verifier import BatchVerifierService
 
@@ -60,24 +61,40 @@ async def run_node_process(args) -> int:
         enc = CounterEncoding()
         if cfg.network == "tcp":
             net = TCPNetwork(rec.address, encoding=enc)
+        elif cfg.network == "quic":
+            net = QUICNetwork(rec.address, encoding=enc)
         else:
             net = UDPNetwork(rec.address, encoding=enc)
         await net.start()
         nets.append(net)
         sk = simkeys.secret_of(rec, scheme)
-        hconf = run.handel.to_config(threshold, seed=nid)
-        hconf.batch_size = cfg.batch_size
-        if shared_service is not None:
-            hconf.verifier = shared_service.verify
-        h = Handel(
-            net,
-            registry,
-            registry.identity(nid),
-            scheme.constructor,
-            MSG,
-            sk.sign(MSG),
-            hconf,
-        )
+        if cfg.baseline:  # comparison protocols (simul/p2p shared binary)
+            from handel_tpu.baselines.gossip import GossipAggregator
+
+            h = GossipAggregator(
+                net,
+                registry,
+                registry.identity(nid),
+                scheme.constructor,
+                MSG,
+                sk.sign(MSG),
+                threshold,
+                connector="full" if cfg.baseline == "nsquare" else "random-k",
+            )
+        else:
+            hconf = run.handel.to_config(threshold, seed=nid)
+            hconf.batch_size = cfg.batch_size
+            if shared_service is not None:
+                hconf.verifier = shared_service.verify
+            h = Handel(
+                net,
+                registry,
+                registry.identity(nid),
+                scheme.constructor,
+                MSG,
+                sk.sign(MSG),
+                hconf,
+            )
         handels.append((nid, h, net))
 
     # barrier: ready to start (one slave per logical node id)
@@ -93,17 +110,19 @@ async def run_node_process(args) -> int:
     measures = []
     for nid, h, net in handels:
         if sink:
+            sig_counters = h.proc if hasattr(h, "proc") else h  # gossip: self
             measures.append(
                 (TimeMeasure(sink, "sigen"), CounterIO(sink, "net", net),
-                 CounterIO(sink, "sigs", h.proc))
+                 CounterIO(sink, "sigs", sig_counters))
             )
         else:
             measures.append(None)
         h.start()
 
-    async def one_done(h: Handel):
-        ms = await h.final_signatures.get()
-        return ms
+    async def one_done(h):
+        if hasattr(h, "final_signatures"):  # Handel
+            return await h.final_signatures.get()
+        return await h.final  # gossip baseline
 
     finals = await asyncio.wait_for(
         asyncio.gather(*(one_done(h) for _, h, _ in handels)),

@@ -146,8 +146,16 @@ async def run_simulation(cfg: SimConfig, workdir: str) -> list[RunResult]:
     plat = LocalhostPlatform(cfg, workdir)
     results = []
     for i in range(len(cfg.runs)):
+        res = None
         for attempt in range(cfg.retrials):
-            res = await plat.start_run(i)
+            try:
+                res = await plat.start_run(i)
+            except asyncio.TimeoutError:
+                # barrier never released (a node died before signaling):
+                # that's exactly what retrials exist for (config.go Retrials)
+                res = RunResult(
+                    ok=False, csv_path="", outputs=[], returncodes=[]
+                )
             if res.ok:
                 break
         results.append(res)

@@ -1,0 +1,120 @@
+"""Result plotting over the monitor's CSV schema.
+
+Reference: simul/plots/*.py (~12 matplotlib scripts — comparison_time.py,
+reallike.py, sigchecked.py, lib.py …) reading the stats CSVs
+(simul/plots/csv/*.csv) whose columns the monitor writes
+(`sigen_wall_avg`, `net_sentBytes_avg`, `sigs_sigCheckedCt_avg`, run/nodes/
+threshold extras).
+
+One module replaces the script pile: each plot function takes CSV paths as
+produced by `Stats.write_csv` (sim/monitor.py) and writes a PNG. CLI:
+`python -m handel_tpu.sim.plots <kind> out.png run1.csv [run2.csv ...]`.
+"""
+
+from __future__ import annotations
+
+import csv
+
+
+def read_rows(path: str) -> list[dict[str, float]]:
+    """CSV -> list of {column: float} rows (plots/lib.py read_csv)."""
+    with open(path, newline="") as f:
+        reader = csv.DictReader(f)
+        return [
+            {k: float(v) for k, v in row.items() if v not in (None, "")}
+            for row in reader
+        ]
+
+
+def _series(rows, xcol, ycol):
+    pts = sorted(
+        (r[xcol], r[ycol]) for r in rows if xcol in r and ycol in r
+    )
+    return [p[0] for p in pts], [p[1] for p in pts]
+
+
+def _plot_xy(series, xlabel, ylabel, out, logx=False, logy=False):
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, ax = plt.subplots(figsize=(7, 4.5))
+    for label, xs, ys in series:
+        ax.plot(xs, ys, marker="o", label=label)
+    ax.set_xlabel(xlabel)
+    ax.set_ylabel(ylabel)
+    if logx:
+        ax.set_xscale("log")
+    if logy:
+        ax.set_yscale("log")
+    ax.grid(True, alpha=0.3)
+    ax.legend()
+    fig.tight_layout()
+    fig.savefig(out, dpi=120)
+    return out
+
+
+def plot_time_vs_nodes(csvs: dict[str, str], out: str):
+    """Completion time vs committee size, one line per protocol/config
+    (plots/comparison_time.py). csvs: label -> path."""
+    series = []
+    for label, path in csvs.items():
+        xs, ys = _series(read_rows(path), "nodes", "sigen_wall_avg")
+        series.append((label, xs, ys))
+    return _plot_xy(series, "nodes", "aggregation time (s)", out, logx=True)
+
+
+def plot_network_vs_nodes(csvs: dict[str, str], out: str):
+    """Per-node bytes sent vs committee size (plots/comparison_net.py)."""
+    series = []
+    for label, path in csvs.items():
+        xs, ys = _series(read_rows(path), "nodes", "net_sentBytes_avg")
+        series.append((label, xs, [y / 1024.0 for y in ys]))
+    return _plot_xy(series, "nodes", "KB sent / node", out, logx=True, logy=True)
+
+
+def plot_sigs_checked(csvs: dict[str, str], out: str):
+    """Signatures checked per node vs committee size (plots/sigchecked.py)."""
+    series = []
+    for label, path in csvs.items():
+        xs, ys = _series(read_rows(path), "nodes", "sigs_sigCheckedCt_avg")
+        series.append((label, xs, ys))
+    return _plot_xy(series, "nodes", "signatures checked / node", out, logx=True)
+
+
+def plot_failing(csvs: dict[str, str], out: str):
+    """Completion time vs number of failing nodes (plots/reallike.py)."""
+    series = []
+    for label, path in csvs.items():
+        xs, ys = _series(read_rows(path), "failing", "sigen_wall_avg")
+        series.append((label, xs, ys))
+    return _plot_xy(series, "failing nodes", "aggregation time (s)", out)
+
+
+KINDS = {
+    "time": plot_time_vs_nodes,
+    "network": plot_network_vs_nodes,
+    "sigchecked": plot_sigs_checked,
+    "failing": plot_failing,
+}
+
+
+def main(argv) -> int:
+    if len(argv) < 3 or argv[0] not in KINDS:
+        print(
+            "usage: python -m handel_tpu.sim.plots "
+            f"{{{'|'.join(KINDS)}}} out.png run1.csv [run2.csv ...]"
+        )
+        return 2
+    kind, out, *paths = argv
+    csvs = {p.rsplit("/", 1)[-1].removesuffix(".csv"): p for p in paths}
+    KINDS[kind](csvs, out)
+    print(out)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    raise SystemExit(main(sys.argv[1:]))

@@ -1,0 +1,88 @@
+"""Confgenerator, plots, and reporter-plane tests.
+
+Reference models: simul/confgenerator/confgenerator.go:18-469 (scenario TOML
+matrix), simul/plots/*.py (CSV -> figures), report.go:5-87 (Values()
+aggregation).
+"""
+
+import os
+
+from handel_tpu.core.report import KernelTimer, ReportAggregator, diff_values
+from handel_tpu.sim.confgen import SCENARIOS, generate
+from handel_tpu.sim.config import load_config
+from handel_tpu.sim.monitor import Stats
+
+
+def test_confgen_all_scenarios_roundtrip(tmp_path):
+    paths = generate(str(tmp_path))
+    assert len(paths) == len(SCENARIOS)
+    for p in paths:
+        cfg = load_config(p)  # every generated TOML must parse back
+        assert cfg.runs, p
+        for r in cfg.runs:
+            assert r.nodes > 0 and 0 < r.resolved_threshold() <= r.nodes
+
+
+def test_confgen_scenario_shapes(tmp_path):
+    (p,) = generate(str(tmp_path), ["failing"])
+    cfg = load_config(p)
+    assert {r.failing for r in cfg.runs} == {0, 400, 1000, 1960}
+    assert all(r.threshold == 2040 for r in cfg.runs)
+    (p,) = generate(str(tmp_path), ["nsquare"])
+    assert load_config(p).baseline == "nsquare"
+
+
+def test_plots_render_png(tmp_path):
+    # fabricate a monitor CSV and render every plot kind
+    stats_rows = []
+    for nodes, wall, sent in [(100, 0.2, 9000), (1000, 0.5, 30000), (4000, 0.9, 57000)]:
+        st = Stats(extra={"nodes": nodes, "failing": 0})
+        for i in range(4):
+            st.update("sigen_wall", wall + 0.01 * i)
+            st.update("net_sentBytes", sent + 100 * i)
+            st.update("sigs_sigCheckedCt", 60 + i)
+        stats_rows.append(st)
+    csv_path = str(tmp_path / "handel.csv")
+    for i, st in enumerate(stats_rows):
+        st.write_csv(csv_path, append=i > 0)
+
+    from handel_tpu.sim import plots
+
+    for kind in ("time", "network", "sigchecked"):
+        out = str(tmp_path / f"{kind}.png")
+        plots.KINDS[kind]({"handel": csv_path}, out)
+        assert os.path.getsize(out) > 1000
+
+
+def test_report_aggregator_prefixes():
+    class R:
+        def __init__(self, **kv):
+            self.kv = kv
+
+        def values(self):
+            return dict(self.kv)
+
+    agg = ReportAggregator(handel=R(msgSentCt=3.0), net=R(sentPackets=5.0))
+    agg.add("verifier", R(launches=2.0))
+    vals = agg.values()
+    assert vals == {
+        "handel_msgSentCt": 3.0,
+        "net_sentPackets": 5.0,
+        "verifier_launches": 2.0,
+    }
+
+
+def test_kernel_timer_counts():
+    timer = KernelTimer(lambda x: x * 2, name="verify")
+    assert timer(21) == 42
+    assert timer(1) == 2
+    vals = timer.values()
+    assert vals["verifyCalls"] == 2.0
+    assert vals["verifyTimeMs"] >= 0.0
+    assert vals["verifyMaxMs"] <= vals["verifyTimeMs"]
+
+
+def test_diff_values():
+    before = {"a": 1.0, "b": 2.0}
+    after = {"a": 4.0, "b": 2.5, "c": 1.0}
+    assert diff_values(before, after) == {"a": 3.0, "b": 0.5, "c": 1.0}

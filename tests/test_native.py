@@ -1,0 +1,95 @@
+"""C++ host backend (handel_tpu/native) vs the pure-Python oracle.
+
+The native library is the host-speed layer standing in for the reference's
+assembly field ops (SURVEY.md §2.2, cloudflare/bn256 dep); every exported op
+is cross-checked against ops/bn254_ref.py on random vectors.
+"""
+
+import random
+
+import pytest
+
+from handel_tpu import native
+from handel_tpu.ops import bn254_ref as bn
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native backend did not build"
+)
+
+RNG = random.Random(20260729)
+
+
+def rand_scalar():
+    return RNG.randrange(1, bn.R)
+
+
+def test_g1_mul_matches_oracle():
+    for _ in range(10):
+        k = rand_scalar()
+        assert native.g1_mul(bn.G1_GEN, k) == bn.g1_mul(bn.G1_GEN, k)
+
+
+def test_g1_small_and_edge_scalars():
+    assert native.g1_mul(bn.G1_GEN, 0) is None
+    assert native.g1_mul(None, 5) is None
+    assert native.g1_mul(bn.G1_GEN, 1) == bn.G1_GEN
+    assert native.g1_mul(bn.G1_GEN, 2) == bn.g1_add(bn.G1_GEN, bn.G1_GEN)
+    # [r]G == O: the subgroup-check path needs unreduced scalars
+    assert native.g1_mul(bn.G1_GEN, bn.R) is None
+
+
+def test_g1_add_cases():
+    p = native.g1_mul(bn.G1_GEN, 123)
+    q = native.g1_mul(bn.G1_GEN, 456)
+    assert native.g1_add(p, q) == bn.g1_add(p, q)
+    assert native.g1_add(p, p) == bn.g1_add(p, p)  # doubling branch
+    assert native.g1_add(p, None) == p
+    assert native.g1_add(None, q) == q
+    assert native.g1_add(p, bn.g1_neg(p)) is None  # inverse branch
+
+
+def test_g2_mul_matches_oracle():
+    for _ in range(4):
+        k = rand_scalar()
+        assert native.g2_mul(bn.G2_GEN, k) == bn.g2_mul(bn.G2_GEN, k)
+    assert native.g2_mul(bn.G2_GEN, bn.R) is None  # subgroup check
+
+
+def test_g2_add_cases():
+    p = native.g2_mul(bn.G2_GEN, 33)
+    q = native.g2_mul(bn.G2_GEN, 44)
+    assert native.g2_add(p, q) == bn.g2_add(p, q)
+    assert native.g2_add(p, p) == bn.g2_add(p, p)
+    assert native.g2_add(p, None) == p
+
+
+def test_batch_and_sum():
+    ks = [rand_scalar() for _ in range(8)]
+    assert native.g1_mul_batch([bn.G1_GEN] * 8, ks) == [
+        bn.g1_mul(bn.G1_GEN, k) for k in ks
+    ]
+    assert native.g2_mul_batch([bn.G2_GEN] * 4, ks[:4]) == [
+        bn.g2_mul(bn.G2_GEN, k) for k in ks[:4]
+    ]
+    pts = native.g1_mul_batch([bn.G1_GEN] * 5, ks[:5])
+    acc = None
+    for p in pts:
+        acc = bn.g1_add(acc, p)
+    assert native.g1_sum(pts + [None]) == acc
+    qts = native.g2_mul_batch([bn.G2_GEN] * 3, ks[:3])
+    acc2 = None
+    for q in qts:
+        acc2 = bn.g2_add(acc2, q)
+    assert native.g2_sum(qts) == acc2
+
+
+def test_sign_verify_through_scheme():
+    """The host scheme rides the native path; signatures must still verify
+    through the oracle pairing."""
+    from handel_tpu.models.bn254 import new_keypair
+
+    sk, pk = new_keypair(seed=7)
+    msg = b"native-backed scheme"
+    sig = sk.sign(msg)
+    assert pk.verify(msg, sig)
+    assert not pk.verify(b"other msg", sig)

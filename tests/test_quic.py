@@ -1,0 +1,103 @@
+"""Secure session transport tests.
+
+Reference model: network/quic/net_test.go (two endpoints exchange a packet
+over TLS sessions) and sessionmanager_test.go:29-92 (concurrent dials to one
+peer share a single session).
+"""
+
+import asyncio
+
+import pytest
+
+from handel_tpu.core.identity import Identity
+from handel_tpu.core.net import Packet
+from handel_tpu.network.quic import (
+    QUICNetwork,
+    SessionManager,
+    new_insecure_test_config,
+)
+from tests.test_network import ChanListener, _free_ports, _mk_packet
+
+
+def test_two_node_exchange_tls():
+    async def go():
+        p1, p2 = _free_ports(2)
+        a = QUICNetwork(f"127.0.0.1:{p1}")
+        b = QUICNetwork(f"127.0.0.1:{p2}")
+        la, lb = ChanListener(), ChanListener()
+        a.register_listener(la)
+        b.register_listener(lb)
+        await a.start()
+        await b.start()
+        try:
+            a.send([Identity(1, f"127.0.0.1:{p2}", None)], _mk_packet(7))
+            got = await asyncio.wait_for(lb.packets.get(), 5)
+            assert got.origin == 7 and got.multisig == b"\x01\x02\x03"
+            b.send([Identity(0, f"127.0.0.1:{p1}", None)], _mk_packet(9))
+            got2 = await asyncio.wait_for(la.packets.get(), 5)
+            assert got2.origin == 9
+            # session reuse: a second send rides the cached session
+            a.send([Identity(1, f"127.0.0.1:{p2}", None)], _mk_packet(8))
+            got3 = await asyncio.wait_for(lb.packets.get(), 5)
+            assert got3.origin == 8
+            assert a.values()["sentPackets"] == 2.0
+        finally:
+            a.stop()
+            b.stop()
+
+    asyncio.run(go())
+
+
+def test_session_manager_dedups_concurrent_dials():
+    """sessionmanager_test.go:29-92: N concurrent sends to one peer must
+    produce exactly one dial."""
+
+    dials = 0
+
+    class FakeWriter:
+        def is_closing(self):
+            return False
+
+        def close(self):
+            pass
+
+    async def dialer(addr):
+        nonlocal dials
+        dials += 1
+        await asyncio.sleep(0.05)  # keep the dial in flight
+        from handel_tpu.network.quic import _Session
+
+        return _Session(FakeWriter())
+
+    async def go():
+        mgr = SessionManager(dialer)
+        sessions = await asyncio.gather(
+            *(mgr.session("peer:1") for _ in range(8))
+        )
+        assert dials == 1
+        assert all(s is sessions[0] for s in sessions)
+
+    asyncio.run(go())
+
+
+def test_session_manager_dial_failure_propagates():
+    async def dialer(addr):
+        raise OSError("refused")
+
+    async def go():
+        mgr = SessionManager(dialer)
+        with pytest.raises(OSError):
+            await mgr.session("peer:2")
+        # a later attempt re-dials (failure isn't cached)
+        with pytest.raises(OSError):
+            await mgr.session("peer:2")
+
+    asyncio.run(go())
+
+
+def test_insecure_config_roundtrip():
+    server_ctx, client_ctx = new_insecure_test_config()
+    import ssl
+
+    assert server_ctx.protocol == ssl.PROTOCOL_TLS_SERVER
+    assert client_ctx.verify_mode == ssl.CERT_NONE
