@@ -198,6 +198,10 @@ class BN254JaxConstructor(BN254Constructor):
         self._device = BN254Device(
             pubkeys, batch_size=self.batch_size, curves=self.curves
         )
+        # hold the list itself: the id() cache key below is only valid while
+        # the original object is alive (id reuse after GC would alias a new
+        # registry to the cached one)
+        self._reg_list = pubkeys
         self._device_for = id(pubkeys)
         self._reg_keys = [pk.point for pk in pubkeys]
         return self._device
@@ -211,6 +215,7 @@ class BN254JaxConstructor(BN254Constructor):
             # NOT verify against stale keys), then adopt the id so repeat
             # calls stay O(1)
             if [pk.point for pk in pubkeys] == self._reg_keys:
+                self._reg_list = pubkeys
                 self._device_for = id(pubkeys)
             else:
                 self.prepare(pubkeys)

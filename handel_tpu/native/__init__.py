@@ -26,21 +26,36 @@ _lib = None
 _tried = False
 
 
-def _build() -> str | None:
+def _build(force: bool = False) -> str | None:
     try:
-        if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(
-            _SRC
+        if (
+            not force
+            and os.path.exists(_LIB)
+            and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC)
         ):
             return _LIB
+        # compile to a per-process temp name and rename into place: many node
+        # processes may race to build on a fresh checkout, and rename() is
+        # atomic so nobody ever dlopens a half-written .so
+        tmp = f"{_LIB}.{os.getpid()}.tmp"
         subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-o", _LIB, _SRC],
+            ["g++", "-O2", "-shared", "-fPIC", "-o", tmp, _SRC],
             check=True,
             capture_output=True,
             timeout=300,
         )
+        os.replace(tmp, _LIB)
         return _LIB
     except Exception:
         return None
+
+
+def _open(path: str):
+    lib = ctypes.CDLL(path)
+    lib.bn254_native_version.restype = ctypes.c_int
+    if lib.bn254_native_version() != 1:
+        raise OSError("native ABI version mismatch")
+    return lib
 
 
 def load():
@@ -56,13 +71,16 @@ def load():
         if path is None:
             return None
         try:
-            lib = ctypes.CDLL(path)
-            lib.bn254_native_version.restype = ctypes.c_int
-            if lib.bn254_native_version() != 1:
-                return None
-            _lib = lib
+            _lib = _open(path)
         except OSError:
-            return None
+            # a stale/torn artifact (e.g. from a crashed build): force a
+            # rebuild once before giving up
+            path = _build(force=True)
+            if path is not None:
+                try:
+                    _lib = _open(path)
+                except OSError:
+                    return None
     return _lib
 
 

@@ -25,8 +25,18 @@ from typing import Callable, Sequence
 from handel_tpu.core.logging import DEFAULT_LOGGER, Logger
 from handel_tpu.core.net import Listener, Packet
 from handel_tpu.network.encoding import BinaryEncoding, Encoding
-from handel_tpu.network.stream import TaskSet, frame, read_frames
+from handel_tpu.network.stream import (
+    Session,
+    SessionManager,
+    TaskSet,
+    frame,
+    read_frames,
+)
 from handel_tpu.network.udp import split_addr
+
+# back-compat aliases: the session machinery moved to network/stream.py so
+# the TCP transport can share it
+_Session = Session
 
 
 def new_insecure_test_config() -> tuple[ssl.SSLContext, ssl.SSLContext]:
@@ -71,64 +81,6 @@ def new_insecure_test_config() -> tuple[ssl.SSLContext, ssl.SSLContext]:
     client_ctx.check_hostname = False
     client_ctx.verify_mode = ssl.CERT_NONE
     return server_ctx, client_ctx
-
-
-class _Session:
-    """One live outbound session (a TLS stream to a peer)."""
-
-    def __init__(self, writer: asyncio.StreamWriter):
-        self.writer = writer
-
-    def alive(self) -> bool:
-        return not self.writer.is_closing()
-
-    def close(self) -> None:
-        self.writer.close()
-
-
-class SessionManager:
-    """Per-peer session cache that dedups concurrent dials
-    (quic/sessionmanager.go:11-93 `simpleSesssionManager`): while a dial to a
-    peer is in flight, other senders await the same future instead of opening
-    a second session."""
-
-    def __init__(self, dialer: Callable):
-        self._dialer = dialer  # async addr -> _Session
-        self._sessions: dict[str, _Session] = {}
-        self._waiting: dict[str, asyncio.Future] = {}  # isWaiting set
-
-    async def session(self, addr: str) -> _Session:
-        ses = self._sessions.get(addr)
-        if ses is not None and ses.alive():
-            return ses
-        fut = self._waiting.get(addr)
-        if fut is not None:  # a dial is already in flight: piggyback
-            return await asyncio.shield(fut)
-        loop = asyncio.get_running_loop()
-        fut = loop.create_future()
-        self._waiting[addr] = fut
-        try:
-            ses = await self._dialer(addr)
-        except BaseException as e:
-            fut.set_exception(e)
-            # consume the exception if nobody else awaited the future
-            fut.exception()
-            raise
-        finally:
-            self._waiting.pop(addr, None)
-        if not fut.done():
-            fut.set_result(ses)
-        self._sessions[addr] = ses
-        return ses
-
-    def drop(self, addr: str) -> None:
-        ses = self._sessions.pop(addr, None)
-        if ses is not None:
-            ses.close()
-
-    def close_all(self) -> None:
-        for addr in list(self._sessions):
-            self.drop(addr)
 
 
 class QUICNetwork:
@@ -178,7 +130,7 @@ class QUICNetwork:
         _, writer = await asyncio.open_connection(
             host, port, ssl=self._client_ctx
         )
-        return _Session(writer)
+        return Session(writer)
 
     # -- inbound ------------------------------------------------------------
 

@@ -58,3 +58,62 @@ class TaskSet:
     def cancel_all(self) -> None:
         for t in list(self._tasks):
             t.cancel()
+
+
+class Session:
+    """One live outbound session (a stream to a peer)."""
+
+    def __init__(self, writer: asyncio.StreamWriter):
+        self.writer = writer
+
+    def alive(self) -> bool:
+        return not self.writer.is_closing()
+
+    def close(self) -> None:
+        self.writer.close()
+
+
+class SessionManager:
+    """Per-peer session cache that dedups concurrent dials
+    (quic/sessionmanager.go:11-93 `simpleSesssionManager`): while a dial to a
+    peer is in flight, other senders await the same future instead of opening
+    a second connection. Transport-agnostic via the dialer seam
+    (quic/dialer.go) — TCP and TLS transports pass their own dialer."""
+
+    def __init__(self, dialer):
+        self._dialer = dialer  # async addr -> Session
+        self._sessions: dict[str, Session] = {}
+        self._waiting: dict[str, asyncio.Future] = {}  # isWaiting set
+
+    async def session(self, addr: str) -> Session:
+        ses = self._sessions.get(addr)
+        if ses is not None and ses.alive():
+            return ses
+        fut = self._waiting.get(addr)
+        if fut is not None:  # a dial is already in flight: piggyback
+            return await asyncio.shield(fut)
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        self._waiting[addr] = fut
+        try:
+            ses = await self._dialer(addr)
+        except BaseException as e:
+            fut.set_exception(e)
+            # consume the exception if nobody else awaited the future
+            fut.exception()
+            raise
+        finally:
+            self._waiting.pop(addr, None)
+        if not fut.done():
+            fut.set_result(ses)
+        self._sessions[addr] = ses
+        return ses
+
+    def drop(self, addr: str) -> None:
+        ses = self._sessions.pop(addr, None)
+        if ses is not None:
+            ses.close()
+
+    def close_all(self) -> None:
+        for addr in list(self._sessions):
+            self.drop(addr)

@@ -4,11 +4,11 @@ Reference: network/tcp/net.go:16-127 — a listener accepting length-delimited
 packet streams, lazy dial-on-send with a per-peer connection cache, and a
 1-minute idle deadline.
 
-asyncio redesign: an asyncio.Server per node; outbound writers are cached per
-peer address and dropped on error (next send re-dials). Concurrent sends to a
-not-yet-connected peer share one in-flight dial (the same dedup the reference
-gives QUIC a session manager for). Framing/read-loop/task bookkeeping live in
-network/stream.py, shared with the TLS transport.
+asyncio redesign: an asyncio.Server per node; outbound connections are cached
+per peer as Sessions behind the shared SessionManager (network/stream.py),
+which also dedups concurrent dials to a not-yet-connected peer — the same
+machinery the TLS transport uses, with a plain-TCP dialer plugged into the
+dialer seam. Framing/read-loop/task bookkeeping also live in stream.py.
 """
 
 from __future__ import annotations
@@ -19,7 +19,13 @@ from typing import Sequence
 from handel_tpu.core.logging import DEFAULT_LOGGER, Logger
 from handel_tpu.core.net import Listener, Packet
 from handel_tpu.network.encoding import Encoding, BinaryEncoding
-from handel_tpu.network.stream import TaskSet, frame, read_frames
+from handel_tpu.network.stream import (
+    Session,
+    SessionManager,
+    TaskSet,
+    frame,
+    read_frames,
+)
 from handel_tpu.network.udp import split_addr
 
 
@@ -37,8 +43,7 @@ class TCPNetwork:
         self.log = logger
         self.listeners: list[Listener] = []
         self._server: asyncio.Server | None = None
-        self._writers: dict[str, asyncio.StreamWriter] = {}
-        self._dialing: dict[str, asyncio.Future] = {}  # dedup in-flight dials
+        self.sessions = SessionManager(self._dial)
         self._tasks = TaskSet()
         self.sent = 0
         self.rcvd = 0
@@ -53,9 +58,12 @@ class TCPNetwork:
         if self._server:
             self._server.close()
         self._tasks.cancel_all()
-        for w in self._writers.values():
-            w.close()
-        self._writers.clear()
+        self.sessions.close_all()
+
+    async def _dial(self, addr: str) -> Session:
+        host, port = split_addr(addr)
+        _, writer = await asyncio.open_connection(host, port)
+        return Session(writer)
 
     async def _handle_conn(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
@@ -75,42 +83,15 @@ class TCPNetwork:
         for ident in identities:
             self._tasks.spawn(self._send_to(ident.address, framed))
 
-    async def _writer_for(self, addr: str) -> asyncio.StreamWriter | None:
-        writer = self._writers.get(addr)
-        if writer is not None and not writer.is_closing():
-            return writer
-        fut = self._dialing.get(addr)
-        if fut is not None:  # piggyback on the in-flight dial
-            return await asyncio.shield(fut)
-        loop = asyncio.get_running_loop()
-        fut = loop.create_future()
-        self._dialing[addr] = fut
-        try:
-            host, port = split_addr(addr)
-            _, writer = await asyncio.open_connection(host, port)
-        except OSError as e:
-            self.log.warn("tcp_dial", f"{addr}: {e}")
-            if not fut.done():
-                fut.set_result(None)
-            return None
-        finally:
-            self._dialing.pop(addr, None)
-        self._writers[addr] = writer
-        if not fut.done():
-            fut.set_result(writer)
-        return writer
-
     async def _send_to(self, addr: str, framed: bytes) -> None:
-        writer = await self._writer_for(addr)
-        if writer is None:
-            return
         try:
-            writer.write(framed)
-            await writer.drain()
+            ses = await self.sessions.session(addr)
+            ses.writer.write(framed)
+            await ses.writer.drain()
             self.sent += 1
         except OSError as e:
             self.log.warn("tcp_send", f"{addr}: {e}")
-            self._writers.pop(addr, None)
+            self.sessions.drop(addr)
 
     def register_listener(self, listener: Listener) -> None:
         self.listeners.append(listener)

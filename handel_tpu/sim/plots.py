@@ -108,7 +108,12 @@ def main(argv) -> int:
         )
         return 2
     kind, out, *paths = argv
-    csvs = {p.rsplit("/", 1)[-1].removesuffix(".csv"): p for p in paths}
+    csvs: dict[str, str] = {}
+    for p in paths:
+        label = p.rsplit("/", 1)[-1].removesuffix(".csv")
+        if label in csvs:  # basename collision: fall back to the full path
+            label = p
+        csvs[label] = p
     KINDS[kind](csvs, out)
     print(out)
     return 0
