@@ -14,7 +14,8 @@ Layer map (mirrors reference SURVEY.md §1, redesigned TPU-first):
   L2a models/     signature schemes (bn254 python/c++/jax, bls12-381, fake)
       ops/        JAX field/curve/pairing kernels (the TPU compute path)
       parallel/   device mesh, sharded multi-pairing, batch verifier service
-  L2b network/    wire encodings + UDP/TCP transports
+  L2b network/    wire encodings + UDP/TCP/TLS-session transports
+      native/     C++ host arithmetic (keygen/sign/aggregate fast path)
   L1  core interfaces (crypto.py, net.py, bitset.py, identity.py)
 """
 

@@ -11,5 +11,11 @@ random-subset connector instead.
 """
 
 from handel_tpu.baselines.gossip import GossipAggregator, run_gossip
+from handel_tpu.baselines.gossipsub import MeshGossipAggregator, run_mesh_gossip
 
-__all__ = ["GossipAggregator", "run_gossip"]
+__all__ = [
+    "GossipAggregator",
+    "run_gossip",
+    "MeshGossipAggregator",
+    "run_mesh_gossip",
+]

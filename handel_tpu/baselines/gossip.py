@@ -126,7 +126,10 @@ class GossipAggregator:
         return self.rand.sample(ids, min(self.fanout, len(ids)))
 
     async def _loop(self) -> None:
-        while not self.final.done():
+        # keep diffusing after our own threshold is met — peers on sparse
+        # overlays may still need our signatures (the reference's aggregator
+        # gossips until the simulation stops it); `stop()` cancels the task
+        while True:
             # diffuse every known individual signature (aggregator.go Diffuse)
             for origin, sig in list(self.sigs.items()):
                 self.net.send(

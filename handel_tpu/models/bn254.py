@@ -129,11 +129,12 @@ class BN254PublicKey:
 
     def verify(self, msg: bytes, sig: BN254Signature) -> bool:
         """e(H(m), X) == e(S, B2), as one product check
-        e(H(m), X) * e(-S, B2) == 1 (bn256/go/bn256.go:82-94)."""
+        e(H(m), X) * e(-S, B2) == 1 (bn256/go/bn256.go:82-94); rides the
+        C++ Miller loop / final exp when the native library is available."""
         if sig.point is None or self.point is None:
             return False
         hm = hash_to_g1(msg)
-        return bn.pairing_check(
+        return nat.pairing_check(
             [(hm, self.point), (bn.g1_neg(sig.point), bn.G2_GEN)]
         )
 

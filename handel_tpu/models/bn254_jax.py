@@ -67,36 +67,57 @@ class BN254Device:
         self._reg_x = T.f2_pack([p[0] for p in pts])  # ((L, N), (L, N))
         self._reg_y = T.f2_pack([p[1] for p in pts])
         self._h_cache: dict[bytes, tuple] = {}
+        # prefix table: slot i = sum of registry keys [0, i) in affine, with
+        # an explicit infinity flag (slot 0). Built lazily on the first
+        # range-path dispatch (dense-only users never pay the scan); after
+        # that every contiguous candidate costs two gathers + one add.
+        self._prefix_cache = None
         self._kernel = jax.jit(self._verify_batch)
+        self._range_kernels: dict[int, callable] = {}
 
-    # -- the jitted batch kernel -------------------------------------------
+    @property
+    def _prefix(self):
+        if self._prefix_cache is None:
+            # never build under an active trace — the result would cache
+            # tracers (see _range_kernel, which pre-materializes on the host)
+            from jax._src import core as _core
 
-    def _verify_batch(self, reg_x, reg_y, mask, sig_x, sig_y, h_x, h_y, valid):
-        """One launch: masked G2 segment-sum + batched multi-pairing.
+            assert _core.trace_state_clean(), (
+                "prefix table must be built outside jit"
+            )
+            self._prefix_cache = self._build_prefix()
+        return self._prefix_cache
 
-        Shapes: reg_* (L, N) Fp2 pairs; mask (N*C,) bool block-major
-        (block i = registry key i across C candidates); sig_*/h_* (L, C);
-        valid (C,) bool. Returns (C,) verdicts.
-        """
+    def _build_prefix(self):
+        g2 = self.curves.g2
+
+        @jax.jit  # one executable for the whole scan + batch affine convert
+        def build(reg_x, reg_y):
+            P = g2.from_affine(reg_x, reg_y)
+            pref = g2.prefix_scan(P)  # inclusive prefix sums, projective
+            return g2.to_affine(pref)
+
+        x, y, inf = build(self._reg_x, self._reg_y)
+        pad = lambda a: jnp.pad(a, ((0, 0), (1, 0)))  # exclusive: slot 0 = O
+        return (
+            (pad(x[0]), pad(x[1])),
+            (pad(y[0]), pad(y[1])),
+            jnp.pad(inf, (1, 0), constant_values=True),
+        )
+
+    # -- the jitted batch kernels ------------------------------------------
+
+    def _pairing_tail(self, agg, sig_x, sig_y, h_x, h_y, valid):
+        """Shared epilogue: affine-convert the aggregates and run the batched
+        product-of-pairings check  e(H, X_j) * e(-S_j, B2) == 1."""
         C = self.batch_size
         g2 = self.curves.g2
-        g1c = self.curves.g1
         T = self.curves.T
         F = self.curves.F
-
-        # registry tiled block-major across candidates, masked, tree-summed
-        tile = lambda a: jnp.repeat(a, C, axis=1)  # (L, N) -> (L, N*C)
-        P2 = g2.from_affine(
-            (tile(reg_x[0]), tile(reg_x[1])), (tile(reg_y[0]), tile(reg_y[1]))
-        )
-        agg = g2.masked_sum(P2, mask, self.n)  # projective, batch C
         agg_inf = g2.is_infinity(agg)
         qx, qy, _ = g2.to_affine(agg)
 
-        # pairs chunk-major: [e(H, X_j)] ++ [e(-S_j, B2)]
-        b2 = self.curves.T.f2_pack([bn.G2_GEN[0]] * 1), self.curves.T.f2_pack(
-            [bn.G2_GEN[1]] * 1
-        )
+        b2 = T.f2_pack([bn.G2_GEN[0]] * 1), T.f2_pack([bn.G2_GEN[1]] * 1)
         bx = (
             jnp.broadcast_to(b2[0][0], qx[0].shape),
             jnp.broadcast_to(b2[0][1], qx[0].shape),
@@ -120,6 +141,70 @@ class BN254Device:
         lane_mask = jnp.concatenate([ok_lane, ok_lane])
         checks = self.pairing.pairing_check((px, py), (qx2, qy2), lane_mask, C)
         return checks & ok_lane
+
+    def _verify_batch(self, reg_x, reg_y, mask, sig_x, sig_y, h_x, h_y, valid):
+        """General launch: masked G2 segment-sum + batched multi-pairing.
+
+        Shapes: reg_* (L, N) Fp2 pairs; mask (N*C,) bool block-major
+        (block i = registry key i across C candidates); sig_*/h_* (L, C);
+        valid (C,) bool. Returns (C,) verdicts. The fallback for arbitrary
+        signer sets — contiguous-range candidates take `_verify_batch_range`.
+        """
+        C = self.batch_size
+        g2 = self.curves.g2
+
+        # registry tiled block-major across candidates, masked, tree-summed
+        tile = lambda a: jnp.repeat(a, C, axis=1)  # (L, N) -> (L, N*C)
+        P2 = g2.from_affine(
+            (tile(reg_x[0]), tile(reg_x[1])), (tile(reg_y[0]), tile(reg_y[1]))
+        )
+        agg = g2.masked_sum(P2, mask, self.n)  # projective, batch C
+        return self._pairing_tail(agg, sig_x, sig_y, h_x, h_y, valid)
+
+    def _gather_prefix(self, idx):
+        """(C,) int32 -> projective G2 batch from the prefix table."""
+        g2 = self.curves.g2
+        (x0, x1), (y0, y1), inf = self._prefix
+        take = lambda a: jnp.take(a, idx, axis=1)
+        P = g2.from_affine((take(x0), take(x1)), (take(y0), take(y1)))
+        return g2.select(jnp.take(inf, idx), g2.infinity(idx.shape[0]), P)
+
+    def _verify_batch_range(
+        self, lo, hi, miss_idx, miss_ok, sig_x, sig_y, h_x, h_y, valid, miss_k
+    ):
+        """Range-candidate launch: per-candidate aggregate key =
+        prefix[hi] - prefix[lo] - sum(missing signers in the hull).
+
+        The O(1)-per-candidate path for Handel traffic, where every
+        candidate's signer set is an ID range of the binomial partitioner
+        (partitioner.go rangeLevel) minus a few offline members. lo/hi: (C,)
+        indices into the prefix table; miss_idx/miss_ok: (miss_k*C,)
+        block-major registry indices + validity for the subtraction patch.
+        """
+        g2 = self.curves.g2
+        C = self.batch_size
+        hull = g2.add(self._gather_prefix(hi), g2.neg(self._gather_prefix(lo)))
+        if miss_k:
+            take = lambda a: jnp.take(a, miss_idx, axis=1)
+            Pm = g2.from_affine(
+                (take(self._reg_x[0]), take(self._reg_x[1])),
+                (take(self._reg_y[0]), take(self._reg_y[1])),
+            )
+            msum = g2.masked_sum(Pm, miss_ok, miss_k)
+            hull = g2.add(hull, g2.neg(msum))
+        return self._pairing_tail(hull, sig_x, sig_y, h_x, h_y, valid)
+
+    def _range_kernel(self, miss_k: int):
+        # materialize the prefix table HERE, on the host, before jit runs:
+        # if the lazy property first fired inside the trace, the cache would
+        # permanently hold tracers from a finished trace and every later
+        # launch would die with UnexpectedTracerError
+        _ = self._prefix
+        fn = self._range_kernels.get(miss_k)
+        if fn is None:
+            fn = jax.jit(partial(self._verify_batch_range, miss_k=miss_k))
+            self._range_kernels[miss_k] = fn
+        return fn
 
     # -- host entry points --------------------------------------------------
 
@@ -146,37 +231,84 @@ class BN254Device:
             out.extend(self._one_launch(msg, requests[i : i + self.batch_size]))
         return out
 
+    # missing-signer patch width cap: candidates whose range hull has more
+    # holes than this fall back to the dense masked-sum kernel
+    MISS_CAP = 64
+
     def _one_launch(self, msg, requests) -> list[bool]:
         C = self.batch_size
         F = self.curves.F
-        mask = np.zeros((self.n, C), dtype=bool)
         sig_pts = []
         valid = np.zeros((C,), dtype=bool)
+        sets: list[np.ndarray] = []
         for j, (bs, sig) in enumerate(requests):
             if len(bs) != self.n:
                 raise ValueError("bitset length != registry size")
-            idx = list(bs.indices())
+            idx = np.fromiter(bs.indices(), dtype=np.int64)
             sig_pt = getattr(sig, "point", None)
-            if idx and sig_pt is not None:
-                mask[idx, j] = True
+            if idx.size and sig_pt is not None:
                 valid[j] = True
                 sig_pts.append(sig_pt)
             else:
                 sig_pts.append(bn.G1_GEN)  # placeholder, lane masked out
+            sets.append(idx)
         sig_pts += [bn.G1_GEN] * (C - len(sig_pts))  # pad lanes
         sig_x = F.pack([p[0] for p in sig_pts])
         sig_y = F.pack([p[1] for p in sig_pts])
         h_x, h_y = self._h_point(msg)
-        verdicts = self._kernel(
-            self._reg_x,
-            self._reg_y,
-            jnp.asarray(mask.reshape(-1)),
-            sig_x,
-            sig_y,
-            h_x,
-            h_y,
-            jnp.asarray(valid),
-        )
+
+        # Handel candidates are partitioner ID ranges with few holes: try the
+        # prefix-table fast path, fall back to the dense kernel otherwise
+        holes = [
+            int(idx[-1] - idx[0] + 1 - idx.size) if v and idx.size else 0
+            for idx, v in zip(sets, valid)
+        ]
+        if max(holes, default=0) <= self.MISS_CAP:
+            lo = np.zeros((C,), np.int32)
+            hi = np.zeros((C,), np.int32)
+            # quantize the patch width to two classes so at most two range
+            # kernels ever compile (each variant jit-compiles the whole
+            # pairing graph; a fresh hole-count class mid-run would
+            # otherwise stall that verification round on XLA)
+            miss_k = 8 if max(holes, default=0) <= 8 else self.MISS_CAP
+            miss_idx = np.zeros((miss_k, C), np.int64)
+            miss_ok = np.zeros((miss_k, C), dtype=bool)
+            for j, idx in enumerate(sets):
+                if not valid[j] or not idx.size:
+                    continue
+                lo[j] = idx[0]
+                hi[j] = idx[-1] + 1
+                missing = np.setdiff1d(
+                    np.arange(idx[0], idx[-1] + 1), idx, assume_unique=True
+                )
+                miss_idx[: missing.size, j] = missing
+                miss_ok[: missing.size, j] = True
+            verdicts = self._range_kernel(miss_k)(
+                jnp.asarray(lo),
+                jnp.asarray(hi),
+                jnp.asarray(miss_idx.reshape(-1)),
+                jnp.asarray(miss_ok.reshape(-1)),
+                sig_x,
+                sig_y,
+                h_x,
+                h_y,
+                jnp.asarray(valid),
+            )
+        else:
+            mask = np.zeros((self.n, C), dtype=bool)
+            for j, idx in enumerate(sets):
+                if valid[j] and idx.size:
+                    mask[idx, j] = True
+            verdicts = self._kernel(
+                self._reg_x,
+                self._reg_y,
+                jnp.asarray(mask.reshape(-1)),
+                sig_x,
+                sig_y,
+                h_x,
+                h_y,
+                jnp.asarray(valid),
+            )
         return [bool(v) for v in np.asarray(verdicts)[: len(requests)]]
 
 

@@ -276,3 +276,71 @@ def g2_sum(points):
     oinf = ctypes.c_int()
     lib.bn254_g2_sum(out, ctypes.byref(oinf), pts, infs, n)
     return _g2_out(out, oinf)
+
+
+def pairing_check(pairs) -> bool:
+    """prod e(p_i, q_i) == 1 with one shared final exponentiation.
+
+    pairs: sequence of (g1_point, g2_point) in oracle representation.
+    Native when available, else the Python oracle (bn254_ref.pairing_check
+    — note the oracle takes (p, q) in the same order)."""
+    lib = load()
+    if lib is None:
+        from handel_tpu.ops import bn254_ref as bn
+
+        return bn.pairing_check(pairs)
+    n = len(pairs)
+    g1s = b"".join(_g1_buf(p)[0] for p, _ in pairs)
+    g1i = (ctypes.c_int * n)(*[1 if p is None else 0 for p, _ in pairs])
+    g2s = b"".join(_g2_buf(q)[0] for _, q in pairs)
+    g2i = (ctypes.c_int * n)(*[1 if q is None else 0 for _, q in pairs])
+    return bool(lib.bn254_pairing_check(g1s, g1i, g2s, g2i, n))
+
+
+def pairing(q, p):
+    """e(P in G1, Q in G2') -> Fp12 in the oracle's nested-tuple form
+    (argument order matches bn254_ref.pairing(q, p)). Infinity inputs give
+    the GT identity, matching the oracle."""
+    if p is None or q is None:
+        from handel_tpu.ops import bn254_ref as bn
+
+        return bn.F12_ONE
+    lib = load()
+    if lib is None:
+        from handel_tpu.ops import bn254_ref as bn
+
+        return bn.pairing(q, p)
+    g1, _ = _g1_buf(p)
+    g2, _ = _g2_buf(q)
+    out = ctypes.create_string_buffer(384)
+    lib.bn254_pairing(out, g1, g2)
+    raw = bytes(out)
+    f2s = [
+        (_b2i(raw[64 * i : 64 * i + 32]), _b2i(raw[64 * i + 32 : 64 * i + 64]))
+        for i in range(6)
+    ]
+    return ((f2s[0], f2s[1], f2s[2]), (f2s[3], f2s[4], f2s[5]))
+
+
+def miller(q, p):
+    """Miller loop only (no final exponentiation), oracle nested-tuple form
+    (argument order matches bn254_ref.miller_loop_projective(q, p))."""
+    if p is None or q is None:
+        from handel_tpu.ops import bn254_ref as bn
+
+        return bn.F12_ONE
+    lib = load()
+    if lib is None:
+        from handel_tpu.ops import bn254_ref as bn
+
+        return bn.miller_loop_projective(q, p)
+    g1, _ = _g1_buf(p)
+    g2, _ = _g2_buf(q)
+    out = ctypes.create_string_buffer(384)
+    lib.bn254_miller(out, g1, g2)
+    raw = bytes(out)
+    f2s = [
+        (_b2i(raw[64 * i : 64 * i + 32]), _b2i(raw[64 * i + 32 : 64 * i + 64]))
+        for i in range(6)
+    ]
+    return ((f2s[0], f2s[1], f2s[2]), (f2s[3], f2s[4], f2s[5]))

@@ -5,8 +5,10 @@
 // library is the equivalent native layer for the host side of the TPU build:
 // keygen, signing, point aggregation, and registry construction at
 // 4000-node simulation scale, where the pure-Python scalar oracle
-// (ops/bn254_ref.py) is orders of magnitude too slow. Device verification
-// stays on the JAX/Pallas path (ops/); this code never does pairings.
+// (ops/bn254_ref.py) is orders of magnitude too slow — plus the host-side
+// pairing (Fp6/Fp12 tower, Miller loop, final exponentiation) used by
+// BN254PublicKey.verify and the gossip baselines. Batched device
+// verification stays on the JAX/Pallas path (ops/).
 //
 // Design: 4x64-bit limb Montgomery arithmetic (CIOS with __uint128_t),
 // Jacobian coordinates for G1 (over Fp, y^2 = x^3 + 3) and G2 (over Fp2 on
@@ -438,6 +440,440 @@ static void store_g2(uint8_t *xy, int *inf, const Jac<Fp2> &p) {
   }
 }
 
+// ---- pairing: Fp6/Fp12 tower, Miller loop, final exponentiation --------
+// Mirrors the scalar oracle (ops/bn254_ref.py): Fp6 = Fp2[v]/(v^3 - xi)
+// with xi = 9+i, Fp12 = Fp6[w]/(w^2 - v), inversion-free projective Miller
+// loop on the twist, easy+hard-part final exponentiation. This is the host
+// verify fast path — the role of the assembly-backed cloudflare/bn256 `Pair`
+// in the reference (bn256/cf/bn256.go:92-93).
+
+static inline void f2_scalar_small(Fp2 &o, const Fp2 &a, int k) {
+  Fp2 acc = a;
+  for (int i = 1; i < k; ++i) f2_add(acc, acc, a);
+  o = acc;
+}
+
+static inline void f2_mul_xi(Fp2 &o, const Fp2 &a) {
+  // (9a0 - a1) + (9a1 + a0) i
+  Fp2 nine;
+  f2_scalar_small(nine, a, 9);
+  Fp r0, r1;
+  fp_sub(r0, nine.c0, a.c1);
+  fp_add(r1, nine.c1, a.c0);
+  o.c0 = r0;
+  o.c1 = r1;
+}
+
+static inline void f2_conj(Fp2 &o, const Fp2 &a) {
+  o.c0 = a.c0;
+  fp_neg(o.c1, a.c1);
+}
+
+struct Fp6 {
+  Fp2 c0, c1, c2;
+};
+struct Fp12 {
+  Fp6 c0, c1;
+};
+
+static inline void f6_add(Fp6 &o, const Fp6 &a, const Fp6 &b) {
+  f2_add(o.c0, a.c0, b.c0);
+  f2_add(o.c1, a.c1, b.c1);
+  f2_add(o.c2, a.c2, b.c2);
+}
+static inline void f6_sub(Fp6 &o, const Fp6 &a, const Fp6 &b) {
+  f2_sub(o.c0, a.c0, b.c0);
+  f2_sub(o.c1, a.c1, b.c1);
+  f2_sub(o.c2, a.c2, b.c2);
+}
+static inline void f6_neg(Fp6 &o, const Fp6 &a) {
+  f2_neg(o.c0, a.c0);
+  f2_neg(o.c1, a.c1);
+  f2_neg(o.c2, a.c2);
+}
+
+static void f6_mul(Fp6 &o, const Fp6 &a, const Fp6 &b) {
+  // Toom/Karatsuba interpolation (bn254_ref.f6_mul)
+  Fp2 t0, t1, t2, s1, s2, u;
+  f2_mul(t0, a.c0, b.c0);
+  f2_mul(t1, a.c1, b.c1);
+  f2_mul(t2, a.c2, b.c2);
+  Fp2 r0, r1, r2;
+  // c0 = t0 + xi*((a1+a2)(b1+b2) - t1 - t2)
+  f2_add(s1, a.c1, a.c2);
+  f2_add(s2, b.c1, b.c2);
+  f2_mul(u, s1, s2);
+  f2_sub(u, u, t1);
+  f2_sub(u, u, t2);
+  f2_mul_xi(u, u);
+  f2_add(r0, t0, u);
+  // c1 = (a0+a1)(b0+b1) - t0 - t1 + xi*t2
+  f2_add(s1, a.c0, a.c1);
+  f2_add(s2, b.c0, b.c1);
+  f2_mul(u, s1, s2);
+  f2_sub(u, u, t0);
+  f2_sub(u, u, t1);
+  Fp2 xt2;
+  f2_mul_xi(xt2, t2);
+  f2_add(r1, u, xt2);
+  // c2 = (a0+a2)(b0+b2) - t0 - t2 + t1
+  f2_add(s1, a.c0, a.c2);
+  f2_add(s2, b.c0, b.c2);
+  f2_mul(u, s1, s2);
+  f2_sub(u, u, t0);
+  f2_sub(u, u, t2);
+  f2_add(r2, u, t1);
+  o.c0 = r0;
+  o.c1 = r1;
+  o.c2 = r2;
+}
+
+static inline void f6_mul_v(Fp6 &o, const Fp6 &a) {
+  Fp2 t;
+  f2_mul_xi(t, a.c2);
+  Fp2 c0 = a.c0, c1 = a.c1;
+  o.c0 = t;
+  o.c1 = c0;
+  o.c2 = c1;
+}
+
+static void f6_inv(Fp6 &o, const Fp6 &a) {
+  Fp2 t0, t1, t2, u, den, inv;
+  // t0 = a0^2 - xi*a1*a2
+  f2_sqr(t0, a.c0);
+  f2_mul(u, a.c1, a.c2);
+  f2_mul_xi(u, u);
+  f2_sub(t0, t0, u);
+  // t1 = xi*a2^2 - a0*a1
+  f2_sqr(t1, a.c2);
+  f2_mul_xi(t1, t1);
+  f2_mul(u, a.c0, a.c1);
+  f2_sub(t1, t1, u);
+  // t2 = a1^2 - a0*a2
+  f2_sqr(t2, a.c1);
+  f2_mul(u, a.c0, a.c2);
+  f2_sub(t2, t2, u);
+  // den = a0*t0 + xi*(a2*t1 + a1*t2)
+  Fp2 d1, d2;
+  f2_mul(d1, a.c2, t1);
+  f2_mul(d2, a.c1, t2);
+  f2_add(u, d1, d2);
+  f2_mul_xi(u, u);
+  f2_mul(den, a.c0, t0);
+  f2_add(den, den, u);
+  f2_inv(inv, den);
+  f2_mul(o.c0, t0, inv);
+  f2_mul(o.c1, t1, inv);
+  f2_mul(o.c2, t2, inv);
+}
+
+static inline void f12_mul(Fp12 &o, const Fp12 &a, const Fp12 &b) {
+  Fp6 t0, t1, s0, s1, u;
+  f6_mul(t0, a.c0, b.c0);
+  f6_mul(t1, a.c1, b.c1);
+  Fp6 r0, r1;
+  f6_mul_v(u, t1);
+  f6_add(r0, t0, u);
+  f6_add(s0, a.c0, a.c1);
+  f6_add(s1, b.c0, b.c1);
+  f6_mul(u, s0, s1);
+  f6_sub(u, u, t0);
+  f6_sub(r1, u, t1);
+  o.c0 = r0;
+  o.c1 = r1;
+}
+
+static inline void f12_sqr(Fp12 &o, const Fp12 &a) { f12_mul(o, a, a); }
+
+static inline void f12_conj(Fp12 &o, const Fp12 &a) {
+  o.c0 = a.c0;
+  f6_neg(o.c1, a.c1);
+}
+
+static void f12_inv(Fp12 &o, const Fp12 &a) {
+  Fp6 t, u, den;
+  Fp6 a0sq, a1sq;
+  f6_mul(a0sq, a.c0, a.c0);
+  f6_mul(a1sq, a.c1, a.c1);
+  f6_mul_v(u, a1sq);
+  f6_sub(den, a0sq, u);
+  f6_inv(den, den);
+  f6_mul(o.c0, a.c0, den);
+  f6_mul(t, a.c1, den);
+  f6_neg(o.c1, t);
+}
+
+// gamma_j = xi^(j*(p-1)/6) (raw, converted to Montgomery at init)
+static const Fp2 GAMMA_RAW[5] = {
+    {{{0xd60b35dadcc9e470ULL, 0x5c521e08292f2176ULL, 0xe8b99fdd76e68b60ULL,
+       0x1284b71c2865a7dfULL}},
+     {{0xca5cf05f80f362acULL, 0x747992778eeec7e5ULL, 0xa6327cfe12150b8eULL,
+       0x246996f3b4fae7e6ULL}}},
+    {{{0x99e39557176f553dULL, 0xb78cc310c2c3330cULL, 0x4c0bec3cf559b143ULL,
+       0x2fb347984f7911f7ULL}},
+     {{0x1665d51c640fcba2ULL, 0x32ae2a1d0b7c9dceULL, 0x4ba4cc8bd75a0794ULL,
+       0x16c9e55061ebae20ULL}}},
+    {{{0xdc54014671a0135aULL, 0xdbaae0eda9c95998ULL, 0xdc5ec698b6e2f9b9ULL,
+       0x063cf305489af5dcULL}},
+     {{0x82d37f632623b0e3ULL, 0x21807dc98fa25bd2ULL, 0x0704b5a7ec796f2bULL,
+       0x07c03cbcac41049aULL}}},
+    {{{0x848a1f55921ea762ULL, 0xd33365f7be94ec72ULL, 0x80f3c0b75a181e84ULL,
+       0x05b54f5e64eea801ULL}},
+     {{0xc13b4711cd2b8126ULL, 0x3685d2ea1bdec763ULL, 0x9f3a80b03b0b1c92ULL,
+       0x2c145edbe7fd8aeeULL}}},
+    {{{0x2ea2c810eab7692fULL, 0x425c459b55aa1bd3ULL, 0xe93a3661a4353ff4ULL,
+       0x0183c1e74f798649ULL}},
+     {{0x24c6b8ee6e0c2c4bULL, 0xb080cb99678e2ac0ULL, 0xa27fb246c7729f7dULL,
+       0x12acf2ca76fd0675ULL}}},
+};
+
+static Fp2 GAMMA_M[6];  // 1-indexed Montgomery-form gammas
+static bool gamma_ready = false;
+
+static void init_gammas() {
+  if (gamma_ready) return;
+  for (int j = 1; j <= 5; ++j) {
+    fp_to_mont(GAMMA_M[j].c0, GAMMA_RAW[j - 1].c0);
+    fp_to_mont(GAMMA_M[j].c1, GAMMA_RAW[j - 1].c1);
+  }
+  gamma_ready = true;
+}
+
+static void f12_frobenius(Fp12 &o, const Fp12 &a) {
+  // w-degrees (0, 2, 4) in c0 and (1, 3, 5) in c1 (bn254_ref.f12_frobenius)
+  Fp2 t;
+  f2_conj(o.c0.c0, a.c0.c0);
+  f2_conj(t, a.c0.c1);
+  f2_mul(o.c0.c1, t, GAMMA_M[2]);
+  f2_conj(t, a.c0.c2);
+  f2_mul(o.c0.c2, t, GAMMA_M[4]);
+  f2_conj(t, a.c1.c0);
+  f2_mul(o.c1.c0, t, GAMMA_M[1]);
+  f2_conj(t, a.c1.c1);
+  f2_mul(o.c1.c1, t, GAMMA_M[3]);
+  f2_conj(t, a.c1.c2);
+  f2_mul(o.c1.c2, t, GAMMA_M[5]);
+}
+
+static const u64 BN_U = 0x44e992b44a6909f1ULL;
+
+static void f12_pow_u64(Fp12 &o, const Fp12 &a, u64 e) {
+  Fp12 result, base = a;
+  // result = 1
+  std::memset(&result, 0, sizeof(result));
+  result.c0.c0.c0 = ONE_M;
+  while (e) {
+    if (e & 1) f12_mul(result, result, base);
+    f12_sqr(base, base);
+    e >>= 1;
+  }
+  o = result;
+}
+
+struct TwistPt {  // affine twist point, never infinity on this path
+  Fp2 x, y;
+};
+
+struct ProjPt {
+  Fp2 X, Y, Z;
+};
+
+// doubling step + tangent line at T evaluated at P (bn254_ref dbl)
+static void miller_dbl(ProjPt &T, Fp12 &line, const Fp &xp, const Fp &yp) {
+  Fp2 XX, YY, YZ, n, d, XYY, XYYZ, e, t, t2;
+  f2_sqr(XX, T.X);
+  f2_sqr(YY, T.Y);
+  f2_mul(YZ, T.Y, T.Z);
+  f2_scalar_small(n, XX, 3);
+  f2_add(d, YZ, YZ);
+  f2_mul(XYY, T.X, YY);
+  f2_mul(XYYZ, XYY, T.Z);
+  f2_sqr(e, n);
+  Fp2 x8;
+  f2_scalar_small(x8, XYYZ, 8);
+  f2_sub(e, e, x8);
+  ProjPt T3;
+  f2_mul(T3.X, e, d);
+  Fp2 x12, nn, yyz2;
+  f2_scalar_small(x12, XYYZ, 12);
+  f2_sqr(nn, n);
+  f2_sub(t, x12, nn);
+  f2_mul(t, n, t);
+  f2_sqr(t2, YY);
+  f2_sqr(yyz2, T.Z);
+  f2_mul(t2, t2, yyz2);
+  f2_scalar_small(t2, t2, 8);
+  f2_sub(T3.Y, t, t2);
+  f2_sqr(t, d);
+  f2_mul(T3.Z, t, d);
+  // line: c0 = 2*Y*Z^2*yp, cw = -(3X^2*Z)*xp, cw3 = 3X^3 - 2Y^2*Z
+  // (xp/yp are base-field, so Fp2-by-Fp scaling is two fp_muls)
+  Fp2 c0, cw, cw3, nZ;
+  f2_mul(t, YZ, T.Z);
+  f2_add(t, t, t);
+  fp_mul(c0.c0, t.c0, yp);
+  fp_mul(c0.c1, t.c1, yp);
+  f2_mul(nZ, n, T.Z);
+  fp_mul(cw.c0, nZ.c0, xp);
+  fp_mul(cw.c1, nZ.c1, xp);
+  f2_neg(cw, cw);
+  Fp2 nX, yyZ;
+  f2_mul(nX, n, T.X);
+  f2_mul(yyZ, YY, T.Z);
+  f2_add(yyZ, yyZ, yyZ);
+  f2_sub(cw3, nX, yyZ);
+  std::memset(&line, 0, sizeof(line));
+  line.c0.c0 = c0;
+  line.c1.c0 = cw;
+  line.c1.c1 = cw3;
+  T = T3;
+}
+
+// mixed addition step T + Q + line through them at P (bn254_ref add)
+static void miller_add(ProjPt &T, Fp12 &line, const TwistPt &Q, const Fp &xp,
+                       const Fp &yp) {
+  Fp2 n, d, dd, x2Z, e, t, u;
+  f2_mul(t, Q.y, T.Z);
+  f2_sub(n, t, T.Y);
+  f2_mul(t, Q.x, T.Z);
+  f2_sub(d, t, T.X);
+  f2_sqr(dd, d);
+  f2_mul(x2Z, Q.x, T.Z);
+  f2_sqr(e, n);
+  f2_mul(e, e, T.Z);
+  f2_add(t, T.X, x2Z);
+  f2_mul(t, t, dd);
+  f2_sub(e, e, t);
+  ProjPt T3;
+  f2_mul(T3.X, e, d);
+  f2_mul(t, x2Z, dd);
+  f2_sub(t, t, e);
+  f2_mul(t, n, t);
+  Fp2 ddd, y2Z;
+  f2_mul(ddd, dd, d);
+  f2_mul(y2Z, Q.y, T.Z);
+  f2_mul(u, y2Z, ddd);
+  f2_sub(T3.Y, t, u);
+  f2_mul(T3.Z, T.Z, ddd);
+  // line: c0 = d*yp, cw = -n*xp, cw3 = n*x2 - d*y2
+  Fp2 c0, cw, cw3;
+  fp_mul(c0.c0, d.c0, yp);
+  fp_mul(c0.c1, d.c1, yp);
+  fp_mul(cw.c0, n.c0, xp);
+  fp_mul(cw.c1, n.c1, xp);
+  f2_neg(cw, cw);
+  Fp2 nx2, dy2;
+  f2_mul(nx2, n, Q.x);
+  f2_mul(dy2, d, Q.y);
+  f2_sub(cw3, nx2, dy2);
+  std::memset(&line, 0, sizeof(line));
+  line.c0.c0 = c0;
+  line.c1.c0 = cw;
+  line.c1.c1 = cw3;
+  T = T3;
+}
+
+// MSB-first bits of 6u+2 with the top bit dropped (64 steps)
+static const char ATE_BITS[] =
+    "1001110101111001011100000011100110111110011101100011101110101000";
+
+static void miller_loop(Fp12 &f, const TwistPt &Q, const Fp &xp,
+                        const Fp &yp) {
+  init_gammas();
+  ProjPt T;
+  T.X = Q.x;
+  T.Y = Q.y;
+  std::memset(&T.Z, 0, sizeof(T.Z));
+  T.Z.c0 = ONE_M;
+  std::memset(&f, 0, sizeof(f));
+  f.c0.c0.c0 = ONE_M;
+  Fp12 line;
+  for (const char *b = ATE_BITS; *b; ++b) {
+    f12_sqr(f, f);
+    miller_dbl(T, line, xp, yp);
+    f12_mul(f, f, line);
+    if (*b == '1') {
+      miller_add(T, line, Q, xp, yp);
+      f12_mul(f, f, line);
+    }
+  }
+  // Frobenius corrections: q1 = psi(Q), q2 = -psi^2(Q)
+  TwistPt q1, q2;
+  Fp2 t;
+  f2_conj(t, Q.x);
+  f2_mul(q1.x, t, GAMMA_M[2]);
+  f2_conj(t, Q.y);
+  f2_mul(q1.y, t, GAMMA_M[3]);
+  f2_conj(t, q1.x);
+  f2_mul(q2.x, t, GAMMA_M[2]);
+  f2_conj(t, q1.y);
+  f2_mul(q2.y, t, GAMMA_M[3]);
+  f2_neg(q2.y, q2.y);
+  miller_add(T, line, q1, xp, yp);
+  f12_mul(f, f, line);
+  miller_add(T, line, q2, xp, yp);
+  f12_mul(f, f, line);
+}
+
+static void final_exp(Fp12 &o, const Fp12 &f_in) {
+  init_gammas();
+  Fp12 f, t;
+  // easy part: f^(p^6-1) = conj(f)*f^-1, then ^(p^2+1)
+  f12_inv(t, f_in);
+  f12_conj(f, f_in);
+  f12_mul(f, f, t);
+  Fp12 fr2;
+  f12_frobenius(fr2, f);
+  f12_frobenius(fr2, fr2);
+  f12_mul(f, fr2, f);
+
+  // hard part (Scott et al. chain; bn254_ref.final_exponentiation)
+  Fp12 fu, fu2, fu3, fp1, fp2_, fp3;
+  f12_pow_u64(fu, f, BN_U);
+  f12_pow_u64(fu2, fu, BN_U);
+  f12_pow_u64(fu3, fu2, BN_U);
+  f12_frobenius(fp1, f);
+  f12_frobenius(fp2_, fp1);
+  f12_frobenius(fp3, fp2_);
+  Fp12 y0, y1, y2, y3, y4, y5, y6;
+  f12_mul(y0, fp1, fp2_);
+  f12_mul(y0, y0, fp3);
+  f12_conj(y1, f);
+  f12_frobenius(y2, fu2);
+  f12_frobenius(y2, y2);
+  f12_frobenius(y3, fu);
+  f12_conj(y3, y3);
+  f12_frobenius(y4, fu2);
+  f12_mul(y4, fu, y4);
+  f12_conj(y4, y4);
+  f12_conj(y5, fu2);
+  f12_frobenius(y6, fu3);
+  f12_mul(y6, fu3, y6);
+  f12_conj(y6, y6);
+
+  Fp12 t0, t1;
+  f12_sqr(t0, y6);
+  f12_mul(t0, t0, y4);
+  f12_mul(t0, t0, y5);
+  f12_mul(t1, y3, y5);
+  f12_mul(t1, t1, t0);
+  f12_mul(t0, t0, y2);
+  f12_sqr(t1, t1);
+  f12_mul(t1, t1, t0);
+  f12_sqr(t1, t1);
+  f12_mul(t0, t1, y1);
+  f12_mul(t1, t1, y0);
+  f12_sqr(t0, t0);
+  f12_mul(o, t0, t1);
+}
+
+static bool f12_is_one(const Fp12 &a) {
+  Fp12 one;
+  std::memset(&one, 0, sizeof(one));
+  one.c0.c0.c0 = ONE_M;
+  return std::memcmp(&a, &one, sizeof(Fp12)) == 0;
+}
+
 }  // namespace
 
 // ---- C ABI --------------------------------------------------------------
@@ -521,6 +957,80 @@ void bn254_g2_sum(uint8_t *out, int *out_inf, const uint8_t *pts,
     jac_add(G2OPS, acc, acc, Q);
   }
   store_g2(out, out_inf, acc);
+}
+
+// Product-of-pairings check: prod e(P_i, Q_i) == 1, one shared final
+// exponentiation (the reference's verify at bn256/cf/bn256.go:86-98 as a
+// single product; same structure as the device kernel's pairing_check).
+// g1 points: 64-byte affine x||y little-endian limbs; g2 points: 128-byte
+// affine x0||x1||y0||y1. Infinity pairs contribute 1 and are skipped.
+int bn254_pairing_check(const uint8_t *g1s, const int *g1_infs,
+                        const uint8_t *g2s, const int *g2_infs, int n) {
+  init_gammas();
+  Fp12 acc;
+  std::memset(&acc, 0, sizeof(acc));
+  acc.c0.c0.c0 = ONE_M;
+  for (int i = 0; i < n; ++i) {
+    if (g1_infs[i] || g2_infs[i]) continue;
+    Fp xp, yp;
+    load_fp(xp, g1s + 64 * i);
+    load_fp(yp, g1s + 64 * i + 32);
+    TwistPt Q;
+    load_fp(Q.x.c0, g2s + 128 * i);
+    load_fp(Q.x.c1, g2s + 128 * i + 32);
+    load_fp(Q.y.c0, g2s + 128 * i + 64);
+    load_fp(Q.y.c1, g2s + 128 * i + 96);
+    Fp12 f;
+    miller_loop(f, Q, xp, yp);
+    f12_mul(acc, acc, f);
+  }
+  Fp12 out;
+  final_exp(out, acc);
+  return f12_is_one(out) ? 1 : 0;
+}
+
+// e(P, Q) marshaled out as 12 Fp values (c0.c0.c0.c0, c0.c0.c1, ... raw
+// little-endian limb order, 384 bytes) — used by the cross-check tests.
+void bn254_pairing(uint8_t *out, const uint8_t *g1, const uint8_t *g2) {
+  init_gammas();
+  Fp xp, yp;
+  load_fp(xp, g1);
+  load_fp(yp, g1 + 32);
+  TwistPt Q;
+  load_fp(Q.x.c0, g2);
+  load_fp(Q.x.c1, g2 + 32);
+  load_fp(Q.y.c0, g2 + 64);
+  load_fp(Q.y.c1, g2 + 96);
+  Fp12 f, e;
+  miller_loop(f, Q, xp, yp);
+  final_exp(e, f);
+  const Fp2 *coords[6] = {&e.c0.c0, &e.c0.c1, &e.c0.c2,
+                          &e.c1.c0, &e.c1.c1, &e.c1.c2};
+  for (int i = 0; i < 6; ++i) {
+    store_fp(out + 64 * i, coords[i]->c0);
+    store_fp(out + 64 * i + 32, coords[i]->c1);
+  }
+}
+
+// Miller loop only (no final exp) — oracle cross-check seam.
+void bn254_miller(uint8_t *out, const uint8_t *g1, const uint8_t *g2) {
+  init_gammas();
+  Fp xp, yp;
+  load_fp(xp, g1);
+  load_fp(yp, g1 + 32);
+  TwistPt Q;
+  load_fp(Q.x.c0, g2);
+  load_fp(Q.x.c1, g2 + 32);
+  load_fp(Q.y.c0, g2 + 64);
+  load_fp(Q.y.c1, g2 + 96);
+  Fp12 f;
+  miller_loop(f, Q, xp, yp);
+  const Fp2 *coords[6] = {&f.c0.c0, &f.c0.c1, &f.c0.c2,
+                          &f.c1.c0, &f.c1.c1, &f.c1.c2};
+  for (int i = 0; i < 6; ++i) {
+    store_fp(out + 64 * i, coords[i]->c0);
+    store_fp(out + 64 * i + 32, coords[i]->c1);
+  }
 }
 
 int bn254_native_version() { return 1; }

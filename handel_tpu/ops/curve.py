@@ -269,6 +269,32 @@ class Curve:
         P = self.select(mask, P, self.infinity(self.ops.batch(P[0])))
         return self.sum_points(P, n)
 
+    def prefix_scan(self, P):
+        """Inclusive prefix sums along the batch axis: out lane i = sum of
+        lanes 0..i. Hillis-Steele doubling scan over the complete add: every
+        stage is one full-width add + shift/select, so all ceil(log2 n)
+        stages share a single op shape (Pallas-friendly, one executable)
+        — unlike `associative_scan`, whose interior odd-width slices each
+        compile separately.
+
+        One-time registry precompute for O(1) range aggregation: a Handel
+        candidate's signer set is an ID range of the binomial partitioner
+        (partitioner.go rangeLevel), so its aggregate key is
+        prefix[hi] - prefix[lo] — two gathers and one add instead of a
+        masked tree-sum over the whole registry."""
+        o = self.ops
+        n = o.batch(P[0])
+        tree = jax.tree_util.tree_map
+        d = 1
+        while d < n:
+            keep = jnp.arange(n) >= d  # lanes with a neighbor d to the left
+            shifted = tree(lambda a: jnp.roll(a, d, axis=-1), P)
+            inf = self.infinity(n)
+            shifted = self.select(keep, shifted, inf)
+            P = self.add(P, shifted)
+            d *= 2
+        return P
+
     # -- affine conversion (host boundary) -----------------------------------
 
     def to_affine(self, P):

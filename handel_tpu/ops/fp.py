@@ -348,8 +348,15 @@ class Field:
 
         n = self.nlimbs
         bsz = a.shape[1]
+        if bsz == 0:  # empty slices show up inside associative_scan
+            return jnp.zeros_like(a)
         if bsz % _LANE != 0:
-            raise ValueError(f"pallas field batch must be a multiple of {_LANE}")
+            # odd widths appear inside library combinators (e.g. the interior
+            # slices of associative_scan): zero-pad to the lane granularity
+            # and slice back — Montgomery 0*0 = 0 stays canonical
+            padded = self.pad_batch(bsz)
+            pad = lambda x: jnp.pad(x, ((0, 0), (0, padded - bsz)))
+            return self._mul_pallas(pad(a), pad(b))[:, :bsz]
         tile = min(_MAX_TILE_B, bsz)
         while bsz % tile != 0:
             tile //= 2

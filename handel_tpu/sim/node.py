@@ -70,8 +70,14 @@ async def run_node_process(args) -> int:
         sk = simkeys.secret_of(rec, scheme)
         if cfg.baseline:  # comparison protocols (simul/p2p shared binary)
             from handel_tpu.baselines.gossip import GossipAggregator
+            from handel_tpu.baselines.gossipsub import MeshGossipAggregator
 
-            h = GossipAggregator(
+            agg_cls, kw = (
+                (MeshGossipAggregator, {})
+                if cfg.baseline == "gossipsub"
+                else (GossipAggregator, {"connector": "full"})
+            )
+            h = agg_cls(
                 net,
                 registry,
                 registry.identity(nid),
@@ -79,7 +85,7 @@ async def run_node_process(args) -> int:
                 MSG,
                 sk.sign(MSG),
                 threshold,
-                connector="full" if cfg.baseline == "nsquare" else "random-k",
+                **kw,
             )
         else:
             hconf = run.handel.to_config(threshold, seed=nid)

@@ -31,3 +31,16 @@ def test_gossip_aggregate_then_verify_real_crypto():
                    timeout=60.0)
     )
     assert len(results) == 4
+
+
+def test_mesh_gossip_completes():
+    """gossipsub-analog mesh baseline (simul/p2p/libp2p/node.go:55-434):
+    fixed-degree overlay still reaches threshold everywhere."""
+    import asyncio
+
+    from handel_tpu.baselines.gossipsub import run_mesh_gossip
+
+    finals = asyncio.run(run_mesh_gossip(12, threshold=7, degree=3))
+    assert len(finals) == 12
+    for ms in finals.values():
+        assert ms.bitset.cardinality() >= 7

@@ -1,0 +1,129 @@
+"""BN254Device batch-verify kernels: prefix-table range path vs dense path.
+
+The range kernel (prefix[hi] - prefix[lo] - missing patch) must agree with
+the masked tree-sum kernel and with host-side verification for every signer-
+set shape: full ranges, ranges with holes, scattered sets, invalid sigs,
+empty/padded lanes. Reference semantics: processing.go:342-368 verify +
+crypto.go:126-134 pubkey aggregation.
+"""
+
+import random
+
+import pytest
+
+from handel_tpu.core.bitset import BitSet
+from handel_tpu.models.bn254 import BN254PublicKey, BN254Signature, hash_to_g1
+from handel_tpu.models.bn254_jax import BN254Device
+from handel_tpu.ops import bn254_ref as bn
+from handel_tpu import native as nat
+
+N = 8
+C = 4
+MSG = b"device kernel test"
+
+
+@pytest.fixture(scope="module")
+def setup():
+    rng = random.Random(99)
+    sks = [rng.randrange(1, bn.R) for _ in range(N)]
+    pks = [BN254PublicKey(nat.g2_mul(bn.G2_GEN, sk)) for sk in sks]
+    device = BN254Device(pks, batch_size=C)
+    h = hash_to_g1(MSG)
+    return device, sks, h
+
+
+def _request(sks, h, signers, corrupt=False):
+    bs = BitSet(N)
+    for i in signers:
+        bs.set(i, True)
+    agg = sum(sks[i] for i in signers) % bn.R
+    if corrupt:
+        agg = (agg + 1) % bn.R
+    return (bs, BN254Signature(nat.g1_mul(h, agg)))
+
+
+def test_range_candidates(setup):
+    device, sks, h = setup
+    reqs = [
+        _request(sks, h, range(0, 8)),          # full registry
+        _request(sks, h, range(2, 6)),          # inner range
+        _request(sks, h, [0, 1, 3, 4], False),  # hole at 2
+        _request(sks, h, range(1, 5), corrupt=True),
+    ]
+    assert device.batch_verify(MSG, reqs) == [True, True, True, False]
+
+
+def test_scattered_and_single(setup):
+    device, sks, h = setup
+    reqs = [
+        _request(sks, h, [0, 7]),   # hull with 6 holes (patched)
+        _request(sks, h, [5]),      # single signer
+        _request(sks, h, [1, 2, 6], corrupt=True),
+        _request(sks, h, [3, 4]),
+    ]
+    assert device.batch_verify(MSG, reqs) == [True, True, False, True]
+
+
+def test_range_and_dense_paths_agree(setup):
+    device, sks, h = setup
+    rng = random.Random(5)
+    reqs = []
+    for _ in range(C):
+        signers = sorted(rng.sample(range(N), rng.randrange(1, N + 1)))
+        reqs.append(_request(sks, h, signers, corrupt=rng.random() < 0.5))
+    expect = device.batch_verify(MSG, reqs)  # dispatches to the range path
+
+    # force the dense kernel on identical requests
+    import numpy as np
+    import jax.numpy as jnp
+
+    mask = np.zeros((N, C), dtype=bool)
+    sig_pts = []
+    valid = np.zeros((C,), dtype=bool)
+    for j, (bs, sig) in enumerate(reqs):
+        idx = list(bs.indices())
+        mask[idx, j] = True
+        valid[j] = True
+        sig_pts.append(sig.point)
+    F = device.curves.F
+    dense = device._kernel(
+        device._reg_x,
+        device._reg_y,
+        jnp.asarray(mask.reshape(-1)),
+        F.pack([p[0] for p in sig_pts]),
+        F.pack([p[1] for p in sig_pts]),
+        *device._h_point(MSG),
+        jnp.asarray(valid),
+    )
+    assert [bool(v) for v in dense] == expect
+
+
+def test_empty_and_padded_lanes(setup):
+    device, sks, h = setup
+    empty = BitSet(N)
+    reqs = [
+        (empty, BN254Signature(bn.G1_GEN)),  # no signers -> invalid lane
+        _request(sks, h, range(0, 3)),
+    ]
+    assert device.batch_verify(MSG, reqs) == [False, True]
+
+
+def test_prefix_table_matches_host(setup):
+    """prefix[i] must equal the host sum of the first i keys."""
+    device, sks, h = setup
+    pref_pts = []
+    (x0, x1), (y0, y1), inf = device._prefix
+    import numpy as np
+
+    T = device.curves.T
+    xs = T.f2_unpack((x0, x1))
+    ys = T.f2_unpack((y0, y1))
+    infs = np.asarray(inf)
+    acc = None
+    for i in range(N + 1):
+        if infs[i]:
+            assert acc is None or i == 0
+        else:
+            assert (xs[i], ys[i]) == acc, f"prefix slot {i}"
+        if i < N:
+            acc = nat.g2_add(acc, nat.g2_mul(bn.G2_GEN, sks[i]))

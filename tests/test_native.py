@@ -93,3 +93,41 @@ def test_sign_verify_through_scheme():
     sig = sk.sign(msg)
     assert pk.verify(msg, sig)
     assert not pk.verify(b"other msg", sig)
+
+
+def test_pairing_matches_oracle():
+    """Native Miller loop + final exp vs the Python oracle, random points."""
+    k, l = rand_scalar(), rand_scalar()
+    p = native.g1_mul(bn.G1_GEN, k)
+    q = native.g2_mul(bn.G2_GEN, l)
+    assert native.pairing(q, p) == bn.pairing(q, p)
+
+
+def test_pairing_check_bls_shape():
+    sk = rand_scalar()
+    h = native.g1_mul(bn.G1_GEN, 777)
+    X = native.g2_mul(bn.G2_GEN, sk)
+    S = native.g1_mul(h, sk)
+    assert native.pairing_check([(h, X), (bn.g1_neg(S), bn.G2_GEN)])
+    bad = native.g1_add(S, bn.G1_GEN)
+    assert not native.pairing_check([(h, X), (bn.g1_neg(bad), bn.G2_GEN)])
+    # infinity pairs contribute the identity
+    assert native.pairing_check([(None, X), (h, None)])
+
+
+def test_pairing_bilinearity():
+    k, l = 1234567, 7654321
+    lhs = native.pairing(
+        native.g2_mul(bn.G2_GEN, l), native.g1_mul(bn.G1_GEN, k)
+    )
+    base = native.pairing(bn.G2_GEN, bn.G1_GEN)
+    assert lhs == bn.f12_pow(base, k * l % bn.R)
+
+
+def test_miller_matches_oracle():
+    k, l = rand_scalar(), rand_scalar()
+    p = native.g1_mul(bn.G1_GEN, k)
+    q = native.g2_mul(bn.G2_GEN, l)
+    assert native.miller(q, p) == bn.miller_loop_projective(q, p)
+    assert native.miller(None, p) == bn.F12_ONE
+    assert native.pairing(None, p) == bn.F12_ONE
