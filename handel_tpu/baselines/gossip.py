@@ -155,11 +155,16 @@ async def run_gossip(
     threshold: int | None = None,
     timeout: float = 20.0,
     scheme=None,
+    aggregator_cls: type | None = None,
     **kwargs,
 ) -> dict[int, MultiSignature]:
-    """Run an n-node gossip aggregation over the in-process router."""
+    """Run an n-node gossip aggregation over the in-process router.
+
+    `aggregator_cls` selects the node implementation (default
+    GossipAggregator; baselines/gossipsub.py passes its mesh variant)."""
     from handel_tpu.core.test_harness import FakeScheme, InProcessNetwork, InProcessRouter
 
+    cls = aggregator_cls or GossipAggregator
     scheme = scheme or FakeScheme()
     threshold = threshold or (n // 2 + 1)
     router = InProcessRouter()
@@ -176,7 +181,7 @@ async def run_gossip(
     for i in range(n):
         net = InProcessNetwork(router, f"gossip-{i}")
         nodes.append(
-            GossipAggregator(
+            cls(
                 net,
                 registry,
                 idents[i],

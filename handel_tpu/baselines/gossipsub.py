@@ -58,43 +58,16 @@ async def run_mesh_gossip(
     degree: int = 8,
     **kwargs,
 ):
-    """n-node mesh-gossip aggregation over the in-process router."""
-    from handel_tpu.core.identity import ArrayRegistry
-    from handel_tpu.core.test_harness import FakeScheme, InProcessNetwork, InProcessRouter
+    """n-node mesh-gossip aggregation over the in-process router
+    (run_gossip with the mesh aggregator plugged in)."""
+    from handel_tpu.baselines.gossip import run_gossip
 
-    scheme = scheme or FakeScheme()
-    threshold = threshold or (n // 2 + 1)
-    router = InProcessRouter()
-    idents, secrets = [], []
-    for i in range(n):
-        sk, pk = scheme.keygen(i)
-        idents.append(Identity(i, f"mesh-{i}", pk))
-        secrets.append(sk)
-    registry = ArrayRegistry(idents)
-    msg = b"mesh gossip baseline msg"
-    nodes = []
-    for i in range(n):
-        net = InProcessNetwork(router, f"mesh-{i}")
-        nodes.append(
-            MeshGossipAggregator(
-                net,
-                registry,
-                idents[i],
-                scheme.constructor,
-                msg,
-                secrets[i].sign(msg),
-                threshold,
-                degree=degree,
-                **kwargs,
-            )
-        )
-    for node in nodes:
-        node.start()
-    try:
-        finals = await asyncio.wait_for(
-            asyncio.gather(*(node.final for node in nodes)), timeout
-        )
-    finally:
-        for node in nodes:
-            node.stop()
-    return dict(zip(range(n), finals))
+    return await run_gossip(
+        n,
+        threshold=threshold,
+        timeout=timeout,
+        scheme=scheme,
+        aggregator_cls=MeshGossipAggregator,
+        degree=degree,
+        **kwargs,
+    )

@@ -133,6 +133,16 @@ def _g2_out(buf, inf) -> tuple | None:
     )
 
 
+
+def _f12_out(raw: bytes):
+    """384-byte C layout -> oracle nested Fp12 tuple (6 x 64-byte Fp2)."""
+    f2s = [
+        (_b2i(raw[64 * i : 64 * i + 32]), _b2i(raw[64 * i + 32 : 64 * i + 64]))
+        for i in range(6)
+    ]
+    return ((f2s[0], f2s[1], f2s[2]), (f2s[3], f2s[4], f2s[5]))
+
+
 # -- public ops (native if possible, oracle fallback) -----------------------
 
 
@@ -314,12 +324,7 @@ def pairing(q, p):
     g2, _ = _g2_buf(q)
     out = ctypes.create_string_buffer(384)
     lib.bn254_pairing(out, g1, g2)
-    raw = bytes(out)
-    f2s = [
-        (_b2i(raw[64 * i : 64 * i + 32]), _b2i(raw[64 * i + 32 : 64 * i + 64]))
-        for i in range(6)
-    ]
-    return ((f2s[0], f2s[1], f2s[2]), (f2s[3], f2s[4], f2s[5]))
+    return _f12_out(bytes(out))
 
 
 def miller(q, p):
@@ -338,9 +343,4 @@ def miller(q, p):
     g2, _ = _g2_buf(q)
     out = ctypes.create_string_buffer(384)
     lib.bn254_miller(out, g1, g2)
-    raw = bytes(out)
-    f2s = [
-        (_b2i(raw[64 * i : 64 * i + 32]), _b2i(raw[64 * i + 32 : 64 * i + 64]))
-        for i in range(6)
-    ]
-    return ((f2s[0], f2s[1], f2s[2]), (f2s[3], f2s[4], f2s[5]))
+    return _f12_out(bytes(out))

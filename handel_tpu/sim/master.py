@@ -1,0 +1,68 @@
+"""Standalone master: sync barriers + monitor sink for multi-host runs.
+
+Reference: simul/master/main.go:36-118 — on a distributed deployment one
+host runs the SyncMaster and the metrics Monitor while node processes on
+other hosts connect over DCN; at END it writes the stats CSV. The localhost
+platform embeds this role in-process (sim/platform.py); this entry point is
+the multi-host form.
+
+Usage: python -m handel_tpu.sim.master --port 5555 --monitor-port 5556 \
+           --expected 64 --csv results.csv [--timeout 600]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+
+from handel_tpu.sim.monitor import Monitor
+from handel_tpu.sim.sync import STATE_END, STATE_START, SyncMaster
+
+
+async def run_master(
+    port: int, monitor_port: int, expected: int, csv: str, timeout: float
+) -> int:
+    monitor = Monitor(monitor_port)
+    await monitor.start()
+    sync = SyncMaster(port, expected)
+    await sync.start()
+    print(f"master: waiting for {expected} nodes on :{port}", flush=True)
+    try:
+        await sync.wait_all(STATE_START, timeout)
+        print("master: START released", flush=True)
+        await sync.wait_all(STATE_END, timeout)
+        print("master: END released", flush=True)
+        # linger: the barrier releases at the probabilistic fraction
+        # (sync.go:92-98), so stragglers may still be resending READY —
+        # keep acking briefly or they'd time out waiting for a dead master
+        await asyncio.sleep(2.0)
+    except asyncio.TimeoutError:
+        print("master: barrier timeout", file=sys.stderr, flush=True)
+        return 1
+    finally:
+        sync.stop()
+        monitor.stop()
+    if csv:
+        monitor.stats.write_csv(csv)
+        print(f"master: stats -> {csv}", flush=True)
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--monitor-port", type=int, required=True)
+    ap.add_argument("--expected", type=int, required=True)
+    ap.add_argument("--csv", default="")
+    ap.add_argument("--timeout", type=float, default=600.0)
+    args = ap.parse_args()
+    return asyncio.run(
+        run_master(
+            args.port, args.monitor_port, args.expected, args.csv, args.timeout
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

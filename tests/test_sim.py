@@ -167,3 +167,61 @@ def test_localhost_platform_bn254_real_crypto(tmp_path):
             print(out.decode(errors="replace"))
             print(err.decode(errors="replace"))
     assert res.ok
+
+
+def test_standalone_master_with_node_processes(tmp_path):
+    """Multi-host form: a standalone master process (sim/master.py,
+    reference simul/master/main.go:36-118) + node processes connecting to
+    it over sockets, stats CSV written at END."""
+    import asyncio
+    import sys
+
+    from handel_tpu.models.registry import new_scheme
+    from handel_tpu.sim import keys as simkeys
+    from handel_tpu.sim.config import SimConfig, RunConfig, dump_config
+    from handel_tpu.sim.platform import free_ports
+
+    async def go():
+        n = 4
+        cfg = SimConfig(network="udp", scheme="fake", runs=[RunConfig(nodes=n)])
+        scheme = new_scheme("fake")
+        ports = free_ports(n + 2)
+        addrs = [f"127.0.0.1:{p}" for p in ports[:n]]
+        recs = simkeys.generate_nodes(scheme, addrs)
+        reg_path = str(tmp_path / "reg.csv")
+        simkeys.write_registry_csv(reg_path, recs)
+        cfg_path = str(tmp_path / "cfg.toml")
+        with open(cfg_path, "w") as f:
+            f.write(dump_config(cfg))
+        csv_path = str(tmp_path / "stats.csv")
+        import os
+
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = {**os.environ, "PYTHONPATH": repo_root}
+        master = await asyncio.create_subprocess_exec(
+            sys.executable, "-m", "handel_tpu.sim.master",
+            "--port", str(ports[n]), "--monitor-port", str(ports[n + 1]),
+            "--expected", str(n), "--csv", csv_path, "--timeout", "60",
+            stdout=asyncio.subprocess.PIPE, stderr=asyncio.subprocess.PIPE,
+            env=env,
+        )
+        node = await asyncio.create_subprocess_exec(
+            sys.executable, "-m", "handel_tpu.sim.node",
+            "--config", cfg_path, "--registry", reg_path,
+            "--master", f"127.0.0.1:{ports[n]}",
+            "--monitor", f"127.0.0.1:{ports[n+1]}",
+            "--run", "0", "--ids", ",".join(map(str, range(n))),
+            stdout=asyncio.subprocess.PIPE, stderr=asyncio.subprocess.PIPE,
+            env=env,
+        )
+        (m_out, m_err), (n_out, n_err) = await asyncio.wait_for(
+            asyncio.gather(master.communicate(), node.communicate()), 90
+        )
+        assert master.returncode == 0, m_err.decode()
+        assert node.returncode == 0, n_err.decode()
+        assert b"END released" in m_out
+        with open(csv_path) as f:
+            header = f.readline()
+        assert "sigen_wall" in header
+
+    asyncio.run(go())
