@@ -17,7 +17,6 @@ redundancy at equal degree.
 
 from __future__ import annotations
 
-import asyncio
 import random
 
 from handel_tpu.baselines.gossip import GossipAggregator

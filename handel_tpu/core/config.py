@@ -54,6 +54,10 @@ class Config:
     new_partitioner: Callable = BinomialPartitioner
     # (store, handel) -> SigEvaluator; default = the store itself
     new_evaluator: Optional[Callable] = None
+    # processing pipeline class (BatchProcessing ctor signature); None =
+    # BatchProcessing. FifoProcessing gives the reference's deprecated
+    # arrival-order strategy for A/B runs (processing.go:380-493)
+    new_processing: Optional[Callable] = None
     # (handel, levels) -> TimeoutStrategy; default = LinearTimeout
     new_timeout: Optional[Callable] = None
 

@@ -152,7 +152,8 @@ class Handel:
             if self.c.new_evaluator
             else self.store
         )
-        self.proc = BatchProcessing(
+        processing_cls = self.c.new_processing or BatchProcessing
+        self.proc = processing_cls(
             self.partitioner,
             constructor,
             msg,

@@ -261,3 +261,23 @@ class BatchProcessing:
                 self.sig_checking_time_ms / checked if checked else 0.0
             ),
         }
+
+
+class FifoProcessing(BatchProcessing):
+    """Arrival-order pipeline without evaluator scoring
+    (the reference's deprecated fifoProcessing, processing.go:380-493).
+
+    Kept for A/B comparison against the evaluator strategy (the
+    confgenerator's `evaluator` scenario sweeps exactly this axis,
+    simul/confgenerator/confgenerator.go). Batching still applies — the
+    first `batch_size` arrivals go to the device together — but nothing is
+    suppressed and nothing is reordered, so a flood of stale candidates is
+    verified in full.
+    """
+
+    def _select_batch(self) -> list[IncomingSig]:
+        batch = [sp for sp in self._todos[: self.batch_size] if sp.ms is not None]
+        self._todos = self._todos[self.batch_size :]
+        self.sig_checked_ct += len(batch)
+        self.sig_queue_size += len(self._todos)
+        return batch

@@ -135,9 +135,28 @@ def scenario_practical(nodes: int = 4000) -> SimConfig:
     )
 
 
+def scenario_evaluator(nodes: int = 2000) -> SimConfig:
+    """Verification-strategy A/B at fixed N: store-scored vs verify-everything
+    vs arrival-order FIFO (confgenerator.go evaluator scenario)."""
+    return SimConfig(
+        network="udp",
+        scheme="bn254-jax",
+        runs=[
+            RunConfig(
+                nodes=nodes,
+                threshold=nodes * 99 // 100,
+                processes=max(1, nodes // 500),
+                handel=HandelParams(evaluator=ev),
+            )
+            for ev in ("store", "eval1", "fifo")
+        ],
+    )
+
+
 SCENARIOS = {
     "node_count": scenario_node_count,
     "threshold_inc": scenario_threshold_inc,
+    "evaluator": scenario_evaluator,
     "failing": scenario_failing,
     "period": scenario_period,
     "timeout": scenario_timeout,

@@ -27,6 +27,10 @@ class HandelParams:
     fast_path: int = 10
     timeout_ms: float = 50.0
     unsafe_sleep_verify_ms: int = 0
+    # verification strategy sweep axis (HandelConfig.Evaluator + the
+    # confgenerator's `evaluator` scenario): "store" (score by the store),
+    # "eval1" (verify everything), "fifo" (arrival order, no scoring)
+    evaluator: str = "store"
 
     def to_config(self, threshold: int, seed: int) -> Config:
         c = Config()
@@ -37,6 +41,16 @@ class HandelParams:
         c.unsafe_sleep_on_verify_ms = self.unsafe_sleep_verify_ms
         c.contributions = threshold
         c.rand = random.Random(seed)
+        if self.evaluator == "eval1":
+            from handel_tpu.core.processing import Evaluator1
+
+            c.new_evaluator = lambda store, h: Evaluator1()
+        elif self.evaluator == "fifo":
+            from handel_tpu.core.processing import FifoProcessing
+
+            c.new_processing = FifoProcessing
+        elif self.evaluator != "store":
+            raise ValueError(f"unknown evaluator {self.evaluator!r}")
         return c
 
 
@@ -105,6 +119,7 @@ def load_config(path: str) -> SimConfig:
                     fast_path=int(h.get("fast_path", 10)),
                     timeout_ms=float(h.get("timeout_ms", 50.0)),
                     unsafe_sleep_verify_ms=int(h.get("unsafe_sleep_verify_ms", 0)),
+                    evaluator=str(h.get("evaluator", "store")),
                 ),
             )
         )
@@ -141,5 +156,6 @@ def dump_config(cfg: SimConfig) -> str:
             f"fast_path = {r.handel.fast_path}",
             f"timeout_ms = {r.handel.timeout_ms}",
             f"unsafe_sleep_verify_ms = {r.handel.unsafe_sleep_verify_ms}",
+            f'evaluator = "{r.handel.evaluator}"',
         ]
     return "\n".join(lines) + "\n"

@@ -16,14 +16,23 @@ import argparse
 import asyncio
 import sys
 
-from handel_tpu.sim.monitor import Monitor
+from handel_tpu.sim.monitor import DataFilter, Monitor
 from handel_tpu.sim.sync import STATE_END, STATE_START, SyncMaster
 
 
 async def run_master(
-    port: int, monitor_port: int, expected: int, csv: str, timeout: float
+    port: int,
+    monitor_port: int,
+    expected: int,
+    csv: str,
+    timeout: float,
+    data_filter: DataFilter | None = None,
+    extra: dict[str, float] | None = None,
 ) -> int:
-    monitor = Monitor(monitor_port)
+    monitor = Monitor(monitor_port, data_filter=data_filter)
+    # run/nodes/threshold/failing columns the plots key on (platform.py does
+    # this in-process; the standalone master takes them from the CLI)
+    monitor.stats.extra.update(extra or {})
     await monitor.start()
     sync = SyncMaster(port, expected)
     await sync.start()
@@ -56,10 +65,47 @@ def main() -> int:
     ap.add_argument("--expected", type=int, required=True)
     ap.add_argument("--csv", default="")
     ap.add_argument("--timeout", type=float, default=600.0)
+
+    def _kv(spec: str) -> tuple[str, float]:
+        key, eq, val = spec.partition("=")
+        try:
+            if not (key and eq):
+                raise ValueError
+            return key, float(val)
+        except ValueError:
+            raise argparse.ArgumentTypeError(
+                f"expected KEY=NUMBER, got {spec!r}"
+            ) from None
+
+    ap.add_argument(
+        "--filter",
+        action="append",
+        default=[],
+        type=_kv,
+        metavar="KEY=PCT",
+        help="percentile outlier filter per stats key (stats.go DataFilter), "
+        "e.g. --filter sigen_wall=99",
+    )
+    ap.add_argument(
+        "--extra",
+        action="append",
+        default=[],
+        type=_kv,
+        metavar="KEY=VAL",
+        help="constant CSV columns (run/nodes/threshold/failing) the plots "
+        "key on, e.g. --extra nodes=4000 --extra threshold=3960",
+    )
     args = ap.parse_args()
+    pcts = dict(args.filter)
     return asyncio.run(
         run_master(
-            args.port, args.monitor_port, args.expected, args.csv, args.timeout
+            args.port,
+            args.monitor_port,
+            args.expected,
+            args.csv,
+            args.timeout,
+            data_filter=DataFilter(pcts) if pcts else None,
+            extra=dict(args.extra),
         )
     )
 

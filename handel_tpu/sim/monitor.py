@@ -109,9 +109,9 @@ class _SinkProto(asyncio.DatagramProtocol):
 class Monitor:
     """UDP sink aggregating every node's measures (monitor.go:41-156)."""
 
-    def __init__(self, port: int):
+    def __init__(self, port: int, data_filter: "DataFilter | None" = None):
         self.port = port
-        self.stats = Stats()
+        self.stats = Stats(data_filter=data_filter)
         self._transport = None
 
     async def start(self) -> None:
@@ -125,12 +125,36 @@ class Monitor:
             self._transport.close()
 
 
+class DataFilter:
+    """Percentile outlier filter applied per key before aggregation
+    (stats.go DataFilter): for each configured key, keep only samples at or
+    below that key's given percentile. Keys not configured pass through."""
+
+    def __init__(self, percentiles: Mapping[str, float] | None = None):
+        self.percentiles = dict(percentiles or {})
+
+    def apply(self, key: str, values: list[float]) -> list[float]:
+        pct = self.percentiles.get(key)
+        if pct is None or not values:
+            return values
+        ordered = sorted(values)
+        # nearest-rank: the ceil(n*pct/100)-th smallest value is the cut
+        rank = max(1, math.ceil(len(ordered) * pct / 100.0))
+        cut = ordered[min(len(ordered), rank) - 1]
+        return [v for v in values if v <= cut]
+
+
 class Stats:
     """Per-key streaming min/max/avg/sum/dev (stats.go:23-480)."""
 
-    def __init__(self, extra: Mapping[str, float] | None = None):
+    def __init__(
+        self,
+        extra: Mapping[str, float] | None = None,
+        data_filter: DataFilter | None = None,
+    ):
         self._keys: dict[str, list[float]] = {}
         self.extra = dict(extra or {})
+        self.filter = data_filter or DataFilter()
 
     def update(self, key: str, value: float) -> None:
         self._keys.setdefault(key, []).append(value)
@@ -144,7 +168,7 @@ class Stats:
     def row(self) -> list[float]:
         out = [self.extra[k] for k in sorted(self.extra)]
         for key in sorted(self._keys):
-            vs = self._keys[key]
+            vs = self.filter.apply(key, self._keys[key])
             n = len(vs)
             avg = sum(vs) / n
             dev = math.sqrt(sum((v - avg) ** 2 for v in vs) / n)

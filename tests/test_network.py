@@ -112,3 +112,15 @@ def test_counter_encoding_standalone():
     assert back.origin == 5
     v = enc.values()
     assert v["sentBytes"] == len(wire) == v["rcvdBytes"]
+
+
+def test_examples_demo_udp():
+    """The network/examples demo: every peer hears from every other
+    (network/examples/start.go:35-85)."""
+    import asyncio
+
+    from handel_tpu.network.examples import run_demo
+
+    heard = asyncio.run(run_demo(3, "udp"))
+    for i, origins in heard.items():
+        assert origins == {j for j in range(3) if j != i}

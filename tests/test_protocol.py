@@ -190,3 +190,21 @@ def test_requeue_retry_cap():
         )
 
     run(go())
+
+
+def test_fifo_processing_cluster():
+    """The deprecated arrival-order pipeline (processing.go:380-493) still
+    completes aggregation — the A/B counterpart to the evaluator strategy."""
+    import random
+
+    from handel_tpu.core.config import Config
+    from handel_tpu.core.processing import FifoProcessing
+
+    def cfg_factory(i):
+        c = Config()
+        c.new_processing = FifoProcessing
+        c.rand = random.Random(7 + i)
+        return c
+
+    results = run(run_cluster(16, timeout=20.0, config_factory=cfg_factory))
+    assert len(results) == 16

@@ -225,3 +225,32 @@ def test_standalone_master_with_node_processes(tmp_path):
         assert "sigen_wall" in header
 
     asyncio.run(go())
+
+
+def test_stats_percentile_filter():
+    """DataFilter drops samples above the configured percentile before
+    aggregation (stats.go DataFilter)."""
+    from handel_tpu.sim.monitor import DataFilter, Stats
+
+    stats = Stats(data_filter=DataFilter({"lat_wall": 50.0}))
+    for v in (1.0, 2.0, 3.0, 100.0):
+        stats.update("lat_wall", v)
+        stats.update("other", v)
+    row = dict(zip(stats.columns(), stats.row()))
+    assert row["lat_wall_max"] <= 3.0  # outlier filtered
+    assert row["other_max"] == 100.0  # unconfigured key passes through
+
+
+def test_evaluator_knob_roundtrip(tmp_path):
+    cfg = SimConfig(
+        scheme="fake",
+        runs=[RunConfig(nodes=8, handel=HandelParams(evaluator="fifo"))],
+    )
+    path = tmp_path / "sim.toml"
+    path.write_text(dump_config(cfg))
+    back = load_config(str(path))
+    assert back.runs[0].handel.evaluator == "fifo"
+    from handel_tpu.core.processing import FifoProcessing
+
+    c = back.runs[0].handel.to_config(5, seed=1)
+    assert c.new_processing is FifoProcessing
