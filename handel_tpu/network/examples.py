@@ -65,20 +65,16 @@ async def run_demo(n_peers: int = 3, kind: str = "udp", timeout: float = 10.0):
         registry = registry_from_records(read_registry_csv(path), scheme)
 
     nets, collectors = [], []
-    for i in range(n_peers):
-        net = _make_network(kind, addresses[i])
-        col = _Collector(expect=n_peers - 1)
-        net.register_listener(col)
-        await net.start()
-        nets.append(net)
-        collectors.append(col)
-
     peers = [registry.identity(i) for i in range(n_peers)]
     try:
-        for i, net in enumerate(nets):
-            others = [p for j, p in enumerate(peers) if j != i]
-            net.send(others, Packet(origin=i, level=1, multisig=b"hello"))
-            # datagrams can race the receiving endpoints; resend until heard
+        for i in range(n_peers):
+            net = _make_network(kind, addresses[i])
+            col = _Collector(expect=n_peers - 1)
+            net.register_listener(col)
+            await net.start()
+            nets.append(net)
+            collectors.append(col)
+        # datagrams can race the receiving endpoints; resend until heard
         async with asyncio.timeout(timeout):
             while not all(c.done.is_set() for c in collectors):
                 for i, (net, col) in enumerate(zip(nets, collectors)):

@@ -202,11 +202,11 @@ class BN254Pairing:
         f = Tw.f12_mul(Tw.f12_conj(f), Tw.f12_inv(f))
         f = Tw.f12_mul(Tw.f12_frobenius2(f), f)
 
-        # hard part (Scott et al. chain; inversion = conjugation now that f is
-        # in the cyclotomic subgroup)
-        fu = Tw.f12_pow_u(f)
-        fu2 = Tw.f12_pow_u(fu)
-        fu3 = Tw.f12_pow_u(fu2)
+        # hard part (Scott et al. chain; inversion = conjugation and squaring
+        # = Granger-Scott cyclotomic squaring now that f is in the subgroup)
+        fu = Tw.f12_pow_u(f, cyclo=True)
+        fu2 = Tw.f12_pow_u(fu, cyclo=True)
+        fu3 = Tw.f12_pow_u(fu2, cyclo=True)
         fp = Tw.f12_frobenius(f)
         fp2 = Tw.f12_frobenius(fp)
         fp3 = Tw.f12_frobenius(fp2)
@@ -218,14 +218,14 @@ class BN254Pairing:
         y5 = Tw.f12_conj(fu2)
         y6 = Tw.f12_conj(Tw.f12_mul(fu3, Tw.f12_frobenius(fu3)))
 
-        t0 = Tw.f12_mul(Tw.f12_mul(Tw.f12_sqr(y6), y4), y5)
+        t0 = Tw.f12_mul(Tw.f12_mul(Tw.f12_cyclo_sqr(y6), y4), y5)
         t1 = Tw.f12_mul(Tw.f12_mul(y3, y5), t0)
         t0 = Tw.f12_mul(t0, y2)
-        t1 = Tw.f12_mul(Tw.f12_sqr(t1), t0)
-        t1 = Tw.f12_sqr(t1)
+        t1 = Tw.f12_mul(Tw.f12_cyclo_sqr(t1), t0)
+        t1 = Tw.f12_cyclo_sqr(t1)
         t0 = Tw.f12_mul(t1, y1)
         t1 = Tw.f12_mul(t1, y0)
-        t0 = Tw.f12_sqr(t0)
+        t0 = Tw.f12_cyclo_sqr(t0)
         return Tw.f12_mul(t0, t1)
 
     # -- top-level entry points ----------------------------------------------

@@ -127,6 +127,16 @@ class Tower:
         c0, t = self._split(prod, 2)
         return (c0, F.add(t, t))
 
+    def f2_sqr_many(self, elems):
+        """Square a list of Fp2 elements in ONE stacked f2_sqr call."""
+        k = len(elems)
+        e = (
+            self._cat([x[0] for x in elems]),
+            self._cat([x[1] for x in elems]),
+        )
+        s = self.f2_sqr(e)
+        return list(zip(self._split(s[0], k), self._split(s[1], k)))
+
     def f2_mul_fp(self, a, s):
         """Fp2 element times a base-field element (2 base muls, stacked)."""
         F = self.F
@@ -295,6 +305,44 @@ class Tower:
     def f12_sqr(self, a):
         return self.f12_mul(a, a)
 
+    def f12_cyclo_sqr(self, a):
+        """Squaring for elements of the cyclotomic subgroup G_{Phi6}(Fp2)
+        (Granger–Scott 2010) — valid ONLY after the easy part of the final
+        exponentiation has mapped the Miller value into that subgroup.
+
+        With f = (x0 + x1 v + x2 v^2) + (x3 + x4 v + x5 v^2) w, the three
+        Fp4 = Fp2[w^3]-subalgebra pairs (x0,x4), (x3,x2), (x1,x5) square
+        independently, and the Phi6 norm relation recovers f^2 from those
+        squares alone:
+
+          a_j = xi*hi_j^2 + lo_j^2,  b_j = 2*lo_j*hi_j   (per Fp4 pair)
+          C0 coords: 3*a_j - 2*x_j ;  C1 coords: 3*b'_j + 2*x_j
+
+        Cost: 9 Fp2 squarings — all fused into ONE width-9B f2_sqr launch
+        (= 18 base muls) vs the generic f12_sqr's 54. The 2ab terms come from
+        (lo+hi)^2 - lo^2 - hi^2 so no extra multiply is spent on them.
+        """
+        x0, x1, x2 = a[0]
+        x3, x4, x5 = a[1]
+        s40, s23, s51 = self.f2_add_many([(x4, x0), (x2, x3), (x5, x1)])
+        q4, q0, q40, q2, q3, q23, q5, q1, q51 = self.f2_sqr_many(
+            [x4, x0, s40, x2, x3, s23, x5, x1, s51]
+        )
+        # cross terms 2*x4*x0, 2*x2*x3, 2*x5*x1
+        d = self.f2_sub_many([(q40, q4), (q23, q2), (q51, q5)])
+        t6, t7, t8 = self.f2_sub_many([(d[0], q0), (d[1], q3), (d[2], q1)])
+        # xi-folded Fp4 squares (one xi add-chain for all four)
+        xt8, xt4, xt2, xt5 = self.f2_mul_xi_many([t8, q4, q2, q5])
+        u0, u1, u2 = self.f2_add_many([(xt4, q0), (xt2, q3), (xt5, q1)])
+        # z = 3u - 2x (C0) / 3t + 2x (C1), via (u -/+ x) doubled + u
+        w = self.f2_sub_many([(u0, x0), (u1, x1), (u2, x2)])
+        w += self.f2_add_many([(xt8, x3), (t6, x4), (t7, x5)])
+        w2 = self.f2_add_many([(t, t) for t in w])
+        z = self.f2_add_many(
+            list(zip(w2, (u0, u1, u2, xt8, t6, t7)))
+        )
+        return ((z[0], z[1], z[2]), (z[3], z[4], z[5]))
+
     def f12_add(self, a, b):
         return (self.f6_add(a[0], b[0]), self.f6_add(a[1], b[1]))
 
@@ -360,17 +408,19 @@ class Tower:
     def f12_frobenius2(self, a):
         return self.f12_frobenius(self.f12_frobenius(a))
 
-    def f12_pow_const(self, a, e: int):
+    def f12_pow_const(self, a, e: int, cyclo: bool = False):
         """a^e for a fixed public exponent via lax.scan (square + selected
         multiply per bit): keeps the traced graph ~60x smaller than unrolling,
         which matters for XLA compile times (task spec: compiler-friendly
-        control flow)."""
+        control flow). cyclo=True uses the 3x-cheaper cyclotomic squaring —
+        only valid when a lives in the cyclotomic subgroup (final exp)."""
         import jax
 
+        sqr = self.f12_cyclo_sqr if cyclo else self.f12_sqr
         bits = jnp.asarray([int(c) for c in bin(e)[2:]], jnp.uint32)
 
         def step(acc, bit):
-            acc = self.f12_sqr(acc)
+            acc = sqr(acc)
             mult = self.f12_mul(acc, a)
             acc = self.f12_select(jnp.broadcast_to(bit == 1, acc[0][0][0].shape[1:]), mult, acc)
             return acc, None
@@ -378,9 +428,9 @@ class Tower:
         acc, _ = jax.lax.scan(step, a, bits[1:])
         return acc
 
-    def f12_pow_u(self, a):
+    def f12_pow_u(self, a, cyclo: bool = False):
         """a^U for the BN parameter U."""
-        return self.f12_pow_const(a, bn.U)
+        return self.f12_pow_const(a, bn.U, cyclo=cyclo)
 
     # -- host conversions ---------------------------------------------------
 

@@ -23,13 +23,21 @@ from handel_tpu.sim.sync import STATE_END, STATE_START, SyncMaster
 
 
 def free_ports(n: int) -> list[int]:
-    """simul/lib/net.go:13-52."""
+    """simul/lib/net.go:13-52. Each port is probed as BOTH udp and tcp so the
+    result is usable by either transport family."""
     socks, ports = [], []
-    for _ in range(n):
-        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-        s.bind(("127.0.0.1", 0))
-        socks.append(s)
-        ports.append(s.getsockname()[1])
+    while len(ports) < n:
+        u = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        u.bind(("127.0.0.1", 0))
+        port = u.getsockname()[1]
+        t = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        try:
+            t.bind(("127.0.0.1", port))
+        except OSError:  # a tcp listener already holds it: try another
+            u.close()
+            continue
+        socks += [u, t]
+        ports.append(port)
     for s in socks:
         s.close()
     return ports

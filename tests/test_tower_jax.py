@@ -97,3 +97,18 @@ def test_f6_mul_v_and_select(T):
     assert got[1] == bn.F12_ONE
     eq = T.f12_eq(ax, ax)
     assert eq.tolist() == [True, True]
+
+
+def test_cyclotomic_square_matches_generic(T):
+    """Granger-Scott cyclotomic squaring agrees with the generic f12 square
+    (and the scalar oracle) on GT elements, where it is valid."""
+    vals = []
+    for _ in range(3):
+        q = bn.g2_mul(bn.G2_GEN, rng.randrange(1, bn.R))
+        p = bn.g1_mul(bn.G1_GEN, rng.randrange(1, bn.R))
+        vals.append(bn.pairing(q, p))
+    a = T.f12_pack(vals)
+    assert T.f12_unpack(T.f12_cyclo_sqr(a)) == [bn.f12_mul(v, v) for v in vals]
+    assert T.f12_unpack(T.f12_pow_u(a, cyclo=True)) == [
+        bn.f12_pow(v, bn.U) for v in vals
+    ]
