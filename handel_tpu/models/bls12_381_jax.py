@@ -1,0 +1,66 @@
+"""BLS-over-BLS12-381 with verification on the JAX/TPU path.
+
+The second device curve behind the Constructor interface — where the
+reference offers two interchangeable BN256 backends (bn256/go, bn256/cf)
+dispatched by the curve registry (simul/lib/config.go:211-225), this
+framework offers two interchangeable PAIRING CURVES on the device path:
+`bn254-jax` and `bls12-381-jax`, sharing one launch engine.
+
+All machinery — dense masked-sum kernel, prefix-table O(1) range path,
+padded fixed-shape launches, async adapter — is inherited from
+models/bn254_jax.py `BN254Device`; this module only binds the BLS12-381
+curve family (381-bit field, M-type twist, |z|-bit Miller loop) and the
+host wire formats of models/bls12_381.py.
+"""
+
+from __future__ import annotations
+
+from handel_tpu.models.bls12_381 import (
+    BLS12381Constructor,
+    BLS12381PublicKey,
+    hash_to_g1,
+    new_keypair,
+)
+from handel_tpu.models.bn254_jax import BN254Device, BN254JaxConstructor
+from handel_tpu.ops import bls12_381_ref as bls
+from handel_tpu.ops.curve import BLS12Curves
+from handel_tpu.ops.pairing import BLS12Pairing
+
+
+class BLS12381Device(BN254Device):
+    """BLS12-381 binding of the device verification engine."""
+
+    ref = bls
+    Curves = BLS12Curves
+    Pairing = BLS12Pairing
+    _hash_to_g1 = staticmethod(hash_to_g1)
+
+
+class BLS12381JaxConstructor(BLS12381Constructor, BN254JaxConstructor):
+    """Constructor whose `batch_verify` runs on the JAX/TPU path; wire
+    formats and single-sig verify stay the host BLS12-381 scheme's."""
+
+    Device = BLS12381Device
+
+    def __init__(self, batch_size: int = 16, curves: BLS12Curves | None = None):
+        BN254JaxConstructor.__init__(self, batch_size=batch_size, curves=curves)
+
+
+class BLS12381JaxScheme:
+    """Keygen facade for harness/simulation use (host keygen, device verify)."""
+
+    def __init__(self, batch_size: int = 16):
+        self.constructor = BLS12381JaxConstructor(batch_size=batch_size)
+
+    def keygen(self, i: int):
+        return new_keypair(seed=i)
+
+    def unmarshal_public(self, data: bytes) -> BLS12381PublicKey:
+        from handel_tpu.models.bls12_381 import unmarshal_g2
+
+        return BLS12381PublicKey(unmarshal_g2(data))
+
+    def unmarshal_secret(self, data: bytes):
+        from handel_tpu.models.bls12_381 import BLS12381SecretKey
+
+        return BLS12381SecretKey.unmarshal(data)

@@ -48,7 +48,15 @@ class BN254Device:
     Holds the registry's public keys as dense (nlimbs, N) G2 coordinate
     arrays uploaded once (SURVEY.md §2.1 identity row: "registry pubkeys
     additionally uploaded once to device memory as a dense G2 array").
+
+    Curve-family bindings are class attributes so the BLS12-381 device
+    (models/bls12_381_jax.py) reuses the whole launch machinery.
     """
+
+    ref = bn  # scalar-oracle module: generators + placeholder points
+    Curves = BN254Curves
+    Pairing = BN254Pairing
+    _hash_to_g1 = staticmethod(hash_to_g1)
 
     def __init__(
         self,
@@ -56,8 +64,8 @@ class BN254Device:
         batch_size: int = 16,
         curves: BN254Curves | None = None,
     ):
-        self.curves = curves or BN254Curves()
-        self.pairing = BN254Pairing(self.curves)
+        self.curves = curves or self.Curves()
+        self.pairing = self.Pairing(self.curves)
         self.batch_size = batch_size
         self.n = len(registry_pubkeys)
         T = self.curves.T
@@ -120,7 +128,10 @@ class BN254Device:
         agg_inf = g2.is_infinity(agg)
         qx, qy, _ = g2.to_affine(agg)
 
-        b2 = T.f2_pack([bn.G2_GEN[0]] * 1), T.f2_pack([bn.G2_GEN[1]] * 1)
+        b2 = (
+            T.f2_pack([self.ref.G2_GEN[0]] * 1),
+            T.f2_pack([self.ref.G2_GEN[1]] * 1),
+        )
         bx = (
             jnp.broadcast_to(b2[0][0], qx[0].shape),
             jnp.broadcast_to(b2[0][1], qx[0].shape),
@@ -214,7 +225,7 @@ class BN254Device:
     def _h_point(self, msg: bytes):
         cached = self._h_cache.get(msg)
         if cached is None:
-            h = hash_to_g1(msg)
+            h = self._hash_to_g1(msg)
             cached = (
                 self.curves.F.pack([h[0]]),
                 self.curves.F.pack([h[1]]),
@@ -253,9 +264,9 @@ class BN254Device:
                 valid[j] = True
                 sig_pts.append(sig_pt)
             else:
-                sig_pts.append(bn.G1_GEN)  # placeholder, lane masked out
+                sig_pts.append(self.ref.G1_GEN)  # placeholder, lane masked out
             sets.append(idx)
-        sig_pts += [bn.G1_GEN] * (C - len(sig_pts))  # pad lanes
+        sig_pts += [self.ref.G1_GEN] * (C - len(sig_pts))  # pad lanes
         sig_x = F.pack([p[0] for p in sig_pts])
         sig_y = F.pack([p[1] for p in sig_pts])
         h_x, h_y = self._h_point(msg)
@@ -323,14 +334,16 @@ class BN254JaxConstructor(BN254Constructor):
     `prepare()`. Marshal/unmarshal and single-sig verify stay host-side.
     """
 
+    Device = BN254Device
+
     def __init__(self, batch_size: int = 16, curves: BN254Curves | None = None):
         self.batch_size = batch_size
-        self.curves = curves or BN254Curves()
+        self.curves = curves or self.Device.Curves()
         self._device: BN254Device | None = None
         self._device_for: int | None = None
 
     def prepare(self, pubkeys: Sequence[BN254PublicKey]) -> BN254Device:
-        self._device = BN254Device(
+        self._device = self.Device(
             pubkeys, batch_size=self.batch_size, curves=self.curves
         )
         # hold the list itself: the id() cache key below is only valid while

@@ -26,7 +26,11 @@ def new_scheme(name: str, **kwargs):
         from handel_tpu.models.bls12_381 import BLS12381Scheme
 
         return BLS12381Scheme()
+    if name in ("bls12-381-jax", "bls12-381-tpu", "bls12381-jax"):
+        from handel_tpu.models.bls12_381_jax import BLS12381JaxScheme
+
+        return BLS12381JaxScheme(**kwargs)
     raise ValueError(f"unknown signature scheme: {name!r}")
 
 
-SCHEMES = ("fake", "bn254", "bn254-jax", "bls12-381")
+SCHEMES = ("fake", "bn254", "bn254-jax", "bls12-381", "bls12-381-jax")

@@ -29,16 +29,23 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from handel_tpu.ops import bls12_381_ref as _bls
 from handel_tpu.ops import bn254_ref as bn
 from handel_tpu.ops.fp import Field
 from handel_tpu.ops.tower import Tower
 
 
 class _FpAdapter:
-    """Base-field element algebra for G1: elements are (nlimbs, B) arrays."""
+    """Base-field element algebra for G1: elements are (nlimbs, B) arrays.
 
-    def __init__(self, F: Field):
+    b3 = 3b for the curve constant (y^2 = x^3 + b): 9 for BN254's b = 3,
+    12 for BLS12-381's b = 4 — both realized as add chains."""
+
+    def __init__(self, F: Field, b3: int = 9):
         self.F = F
+        self.b3 = b3
+        if b3 not in (9, 12):
+            raise ValueError(f"unsupported curve constant b3={b3}")
 
     def add(self, a, b):
         return self.F.add(a, b)
@@ -75,11 +82,11 @@ class _FpAdapter:
         return [prod[:, i * b : (i + 1) * b] for i in range(k)]
 
     def mul_b3(self, a):
-        """x * 9 (G1: y^2 = x^3 + 3, so b3 = 3b = 9) — add chain, no mul."""
+        """x * b3 by add chain, no mul (x9 for BN254, x12 for BLS12-381)."""
         a2 = self.F.add(a, a)
         a4 = self.F.add(a2, a2)
         a8 = self.F.add(a4, a4)
-        return self.F.add(a8, a)
+        return self.F.add(a8, a if self.b3 == 9 else a4)
 
     def inv(self, a):
         return self.F.inv(a)
@@ -98,10 +105,11 @@ class _FpAdapter:
 class _Fp2Adapter:
     """Quadratic-extension algebra for G2': elements are Fp2 pairs."""
 
-    def __init__(self, T: Tower):
+    def __init__(self, T: Tower, params=bn):
         self.T = T
-        # E' coefficient b' = 3/xi; b3 = 3*b' as a host constant
-        self._b3 = bn.f2_scalar(bn.TWIST_B, 3)
+        # E' twist coefficient b' (3/xi for BN254's D-twist, 4*xi for
+        # BLS12-381's M-twist); b3 = 3*b' as a host constant
+        self._b3 = params.f2_scalar(params.TWIST_B, 3)
         self._b3_packed = None
 
     def add(self, a, b):
@@ -327,18 +335,25 @@ class Curve:
 
 
 class BN254Curves:
-    """The two BN254 groups sharing one Field/Tower, plus host conversions."""
+    """The two pairing groups sharing one Field/Tower, plus host conversions.
+
+    Parameterized by the scalar-oracle module (`params`): BN254 by default;
+    `BLS12Curves` below binds the same machinery to BLS12-381 (b = 4,
+    M-type twist, 381-bit field)."""
+
+    params = bn
+    g1_b3 = 9  # 3*b for E: y^2 = x^3 + 3
 
     def __init__(self, field: Field | None = None, tower: Tower | None = None):
-        self.F = field or Field(bn.P)
-        self.T = tower or Tower(self.F)
-        self.g1 = Curve(_FpAdapter(self.F))
-        self.g2 = Curve(_Fp2Adapter(self.T))
+        self.F = field or Field(self.params.P)
+        self.T = tower or Tower(self.F, params=self.params)
+        self.g1 = Curve(_FpAdapter(self.F, b3=self.g1_b3))
+        self.g2 = Curve(_Fp2Adapter(self.T, params=self.params))
 
     # -- host packing: scalar oracle points <-> device batches ---------------
 
     def pack_g1(self, pts):
-        """List of bn254_ref affine G1 points (or None) -> projective batch."""
+        """List of scalar-oracle affine G1 points (or None) -> projective batch."""
         xs = [0 if p is None else p[0] for p in pts]
         ys = [1 if p is None else p[1] for p in pts]
         zs = [0 if p is None else 1 for p in pts]
@@ -354,10 +369,10 @@ class BN254Curves:
         return [None if infs[i] else (xs[i], ys[i]) for i in range(len(xs))]
 
     def pack_g2(self, pts):
-        f20 = bn.F2_ZERO
+        f20, f21 = (0, 0), (1, 0)
         xs = [f20 if p is None else p[0] for p in pts]
-        ys = [bn.F2_ONE if p is None else p[1] for p in pts]
-        zs = [f20 if p is None else bn.F2_ONE for p in pts]
+        ys = [f21 if p is None else p[1] for p in pts]
+        zs = [f20 if p is None else f21 for p in pts]
         return (self.T.f2_pack(xs), self.T.f2_pack(ys), self.T.f2_pack(zs))
 
     def unpack_g2(self, P):
@@ -379,3 +394,12 @@ class BN254Curves:
             for i in range(nbits):
                 out[nbits - 1 - i, j] = (k >> i) & 1
         return jnp.asarray(out)
+
+
+class BLS12Curves(BN254Curves):
+    """BLS12-381 binding: E: y^2 = x^3 + 4 (b3 = 12) over the 381-bit field,
+    E'(Fp2) with the M-type twist coefficient 4(1+i)
+    (ops/bls12_381_ref.py TWIST_B)."""
+
+    params = _bls
+    g1_b3 = 12

@@ -36,8 +36,9 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from handel_tpu.ops import bls12_381_ref as bls
 from handel_tpu.ops import bn254_ref as bn
-from handel_tpu.ops.curve import BN254Curves
+from handel_tpu.ops.curve import BLS12Curves, BN254Curves
 from handel_tpu.ops.fp import Field
 from handel_tpu.ops.tower import Tower
 
@@ -50,13 +51,17 @@ class BN254Pairing:
     """Batched optimal-ate pairing over the shared Field/Tower/Curves stack."""
 
     def __init__(self, curves: BN254Curves | None = None):
-        self.curves = curves or BN254Curves()
+        self.curves = curves or self._default_curves()
         self.F: Field = self.curves.F
         self.T: Tower = self.curves.T
         # psi-Frobenius constants for the ate correction points
         # (bn254_ref.miller_loop_projective: gamma_2 for x, gamma_3 for y)
-        self._g2c = bn._GAMMA[2]
-        self._g3c = bn._GAMMA[3]
+        self._g2c = self.curves.params._GAMMA[2]
+        self._g3c = self.curves.params._GAMMA[3]
+
+    @classmethod
+    def _default_curves(cls):
+        return BN254Curves()
 
     # -- small helpers -------------------------------------------------------
 
@@ -74,15 +79,18 @@ class BN254Pairing:
             a = T.f2_add(a, a)
         return a
 
-    def _line_f12(self, c0, cw, cw3, batch):
-        """Sparse line -> full Fp12 element: slots w^0, w^1, w^3 = v*w.
+    def _line_f12(self, line, batch):
+        """Sparse line -> full Fp12 element. The step formulas emit
+        (yp-term, xp-term, constant); the D-twist untwist puts them at
+        w-degree slots 0, 1, 3 (w^3 = v*w).
 
         (Kept as a full element so the accumulator update is the single
         stacked f12_mul launch; a 15-mul sparse multiply saves ~17% arithmetic
         but triples the kernel-launch count — measured slower.)
         """
+        c_yp, c_xp, c_const = line
         z = self.T.f2_zero(batch)
-        return ((c0, z, z), (cw, cw3, z))
+        return ((c_yp, z, z), (c_xp, c_const, z))
 
     # -- Miller-loop steps (bn254_ref.miller_loop_projective dbl/add) --------
 
@@ -147,8 +155,12 @@ class BN254Pairing:
 
     # -- Miller loop ---------------------------------------------------------
 
+    # loop bits for the shared scan (overridden per curve family)
+    _LOOP_BITS = _ATE_BITS
+
     def miller_loop(self, p, q, mask=None):
-        """Batched Miller loop f_{6u+2,Q}(P) with ate Frobenius corrections.
+        """Batched Miller loop: shared dbl/add scan over the family's static
+        loop bits, then the family tail (`_miller_tail`).
 
         p: (xp, yp) base-field limb arrays (G1 affine), q: ((x...), (y...))
         Fp2 pairs (G2' affine), mask: optional (B,) bool — lanes with mask
@@ -158,15 +170,15 @@ class BN254Pairing:
         xp, yp = p
         xq, yq = q
         batch = xp.shape[1]
-        bits = jnp.asarray(_ATE_BITS, jnp.uint32)
+        bits = jnp.asarray(self._LOOP_BITS, jnp.uint32)
 
         def step(carry, bit):
             Tpt, f = carry
             f = Tw.f12_sqr(f)
             Tpt, line = self._dbl_step(Tpt, xp, yp)
-            f = Tw.f12_mul(f, self._line_f12(*line, batch))
+            f = Tw.f12_mul(f, self._line_f12(line, batch))
             Ta, line_a = self._add_step(Tpt, (xq, yq), xp, yp)
-            fa = Tw.f12_mul(f, self._line_f12(*line_a, batch))
+            fa = Tw.f12_mul(f, self._line_f12(line_a, batch))
             takes = jnp.broadcast_to(bit == 1, (batch,))
             Tpt = tuple(Tw.f2_select(takes, a, b) for a, b in zip(Ta, Tpt))
             f = Tw.f12_select(takes, fa, f)
@@ -174,22 +186,26 @@ class BN254Pairing:
 
         T0 = (xq, yq, Tw.f2_one(batch))
         (Tpt, f), _ = jax.lax.scan(step, (T0, Tw.f12_one(batch)), bits)
+        f = self._miller_tail(Tpt, f, (xq, yq), xp, yp, batch)
 
-        # ate corrections: q1 = psi(Q), q2 = -psi^2(Q) on the twist
-        # (bn254_ref.miller_loop_projective tail)
+        if mask is not None:
+            f = Tw.f12_select(mask, f, Tw.f12_one(batch))
+        return f
+
+    def _miller_tail(self, Tpt, f, q, xp, yp, batch):
+        """BN ate corrections: add psi(Q) and -psi^2(Q) on the twist
+        (bn254_ref.miller_loop_projective tail)."""
+        Tw = self.T
+        xq, yq = q
         g2 = Tw.f2_constant(self._g2c, batch)
         g3 = Tw.f2_constant(self._g3c, batch)
         q1x, q1y = self._mm([(Tw.f2_conj(xq), g2), (Tw.f2_conj(yq), g3)])
         q2x, q2y = self._mm([(Tw.f2_conj(q1x), g2), (Tw.f2_conj(q1y), g3)])
         q2y = Tw.f2_neg(q2y)  # q2 = -psi^2(Q)
         Tpt, line = self._add_step(Tpt, (q1x, q1y), xp, yp)
-        f = Tw.f12_mul(f, self._line_f12(*line, batch))
+        f = Tw.f12_mul(f, self._line_f12(line, batch))
         _, line = self._add_step(Tpt, (q2x, q2y), xp, yp)
-        f = Tw.f12_mul(f, self._line_f12(*line, batch))
-
-        if mask is not None:
-            f = Tw.f12_select(mask, f, Tw.f12_one(batch))
-        return f
+        return Tw.f12_mul(f, self._line_f12(line, batch))
 
     # -- final exponentiation ------------------------------------------------
 
@@ -261,3 +277,56 @@ class BN254Pairing:
         for i in range(1, per):
             acc = self.T.f12_mul(acc, slice_chunk(i))
         return self.gt_is_one(self.final_exp(acc))
+
+
+class BLS12Pairing(BN254Pairing):
+    """Batched optimal-ate pairing for BLS12-381 (ops/bls12_381_ref.py).
+
+    Shares the projective dbl/add step formulas and the scan machinery with
+    the BN254 engine — the step outputs (yp-term, xp-term, constant) are
+    family-independent; what changes is:
+
+      * loop bits: |z| (z = -0xd201..., 63 bits, weight 6) with a final
+        conjugation because z < 0 — no ate correction additions;
+      * line slot placement: the M-type twist untwist puts the coefficients
+        at w-degrees (0, 2, 3) = Fp12 slots a0[0], a0[1], a1[1], with the
+        CONSTANT at w^0 (the D-twist puts the yp-term there);
+      * final exponentiation: the BLS12 hard part
+        (z-1)^2 (z+p) (z^2+p^2-1) + 3 — computing the cubed pairing, a
+        standard bilinear substitute since gcd(3, r) = 1
+        (bls12_381_ref.final_exponentiation).
+    """
+
+    _LOOP_BITS = [int(c) for c in bin(-bls.Z)[3:]]
+
+    @classmethod
+    def _default_curves(cls):
+        return BLS12Curves()
+
+    def _line_f12(self, line, batch):
+        c_yp, c_xp, c_const = line
+        z = self.T.f2_zero(batch)
+        return ((c_const, c_xp, z), (z, c_yp, z))
+
+    def _miller_tail(self, Tpt, f, q, xp, yp, batch):
+        # z < 0: f_z = 1/f_{|z|} up to final exp -> conjugate
+        return self.T.f12_conj(f)
+
+    def _pow_z(self, x):
+        """x^z in the cyclotomic subgroup (z < 0: pow |z|, then conjugate)."""
+        return self.T.f12_conj(self.T.f12_pow_const(x, -bls.Z, cyclo=True))
+
+    def final_exp(self, f):
+        """Easy part + BLS12 hard part via
+        3(p^4-p^2+1)/r = (z-1)^2 (z+p) (z^2+p^2-1) + 3
+        (bls12_381_ref.final_exponentiation, device form with cyclotomic
+        squarings)."""
+        Tw = self.T
+        f = Tw.f12_mul(Tw.f12_conj(f), Tw.f12_inv(f))  # f^(p^6-1)
+        f = Tw.f12_mul(Tw.f12_frobenius2(f), f)  # ^(p^2+1)
+        t0 = Tw.f12_mul(self._pow_z(f), Tw.f12_conj(f))  # f^(z-1)
+        t1 = Tw.f12_mul(self._pow_z(t0), Tw.f12_conj(t0))  # f^((z-1)^2)
+        g = Tw.f12_mul(self._pow_z(t1), Tw.f12_frobenius(t1))  # ^(z+p)
+        gz2 = self._pow_z(self._pow_z(g))
+        h = Tw.f12_mul(Tw.f12_mul(gz2, Tw.f12_frobenius2(g)), Tw.f12_conj(g))
+        return Tw.f12_mul(h, Tw.f12_mul(Tw.f12_cyclo_sqr(f), f))  # * f^3

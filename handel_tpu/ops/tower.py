@@ -28,15 +28,24 @@ def _split3(x):
 
 
 class Tower:
-    """Fp2/Fp6/Fp12 arithmetic over a base Field (BN254 tower shape:
-    i^2 = -1, v^3 = xi = 9+i, w^2 = v; bn254_ref.py)."""
+    """Fp2/Fp6/Fp12 arithmetic over a base Field (tower shape shared by BN254
+    and BLS12-381: i^2 = -1, v^3 = xi, w^2 = v).
 
-    def __init__(self, field: Field | None = None):
-        self.F = field or Field(bn.P)
+    `params` is the scalar-oracle module defining the curve family's field
+    constants — P, XI, _GAMMA, and (for BN) U. Defaults to BN254
+    (ops/bn254_ref.py); pass ops/bls12_381_ref for the 381-bit tower with
+    xi = 1 + i."""
+
+    def __init__(self, field: Field | None = None, params=bn):
+        self.params = params
+        self.F = field or Field(params.P)
+        self.xi = tuple(params.XI)
+        if self.xi not in ((9, 1), (1, 1)):
+            raise ValueError(f"unsupported Fp6 non-residue xi={self.xi}")
         # Frobenius constants gamma_j = xi^(j(p-1)/6) as Montgomery limb pairs
         self._gamma = [None] + [
             tuple(self.F.pack([g[0], g[1]])[:, i : i + 1] for i in range(2))
-            for g in bn._GAMMA[1:]
+            for g in params._GAMMA[1:]
         ]
 
     # -- raw limb stacking (ONE carry-propagating Field call for many ops) --
@@ -153,24 +162,29 @@ class Tower:
         return F.add(z8, z)
 
     def f2_mul_xi(self, a):
-        """Multiply by xi = 9 + i via add chains (no base mul):
-        (9a0 - a1, 9a1 + a0). One stacked x9 chain for both components."""
+        """Multiply by the Fp6 non-residue via add chains (no base mul).
+        xi = 9+i (BN254): (9a0 - a1, 9a1 + a0), one stacked x9 chain;
+        xi = 1+i (BLS12-381): (a0 - a1, a0 + a1)."""
         F = self.F
+        if self.xi == (1, 1):
+            return (F.sub(a[0], a[1]), F.add(a[0], a[1]))
         n9 = self._x9(self._cat([a[0], a[1]]))
         n90, n91 = self._split(n9, 2)
         return (F.sub(n90, a[1]), F.add(n91, a[0]))
 
     def f2_mul_xi_many(self, elems):
-        """xi * e for a list of Fp2 elements — one stacked x9 chain."""
+        """xi * e for a list of Fp2 elements — one stacked chain."""
         k = len(elems)
-        n9 = self._x9(self._cat([e[0] for e in elems] + [e[1] for e in elems]))
+        c0s = self._cat([e[0] for e in elems])
+        c1s = self._cat([e[1] for e in elems])
+        if self.xi == (1, 1):
+            d = self.F.sub(c0s, c1s)
+            s = self.F.add(c0s, c1s)
+            return list(zip(self._split(d, k), self._split(s, k)))
+        n9 = self._x9(self._cat([c0s, c1s]))
         parts = self._split(n9, 2 * k)
-        d = self.F.sub(
-            self._cat(parts[:k]), self._cat([e[1] for e in elems])
-        )
-        s = self.F.add(
-            self._cat(parts[k:]), self._cat([e[0] for e in elems])
-        )
+        d = self.F.sub(self._cat(parts[:k]), c1s)
+        s = self.F.add(self._cat(parts[k:]), c0s)
         return list(zip(self._split(d, k), self._split(s, k)))
 
     def f2_inv(self, a):
@@ -429,8 +443,8 @@ class Tower:
         return acc
 
     def f12_pow_u(self, a, cyclo: bool = False):
-        """a^U for the BN parameter U."""
-        return self.f12_pow_const(a, bn.U, cyclo=cyclo)
+        """a^U for the BN parameter U (BN254 tower only)."""
+        return self.f12_pow_const(a, self.params.U, cyclo=cyclo)
 
     # -- host conversions ---------------------------------------------------
 

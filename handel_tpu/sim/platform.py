@@ -35,6 +35,7 @@ def free_ports(n: int) -> list[int]:
             t.bind(("127.0.0.1", port))
         except OSError:  # a tcp listener already holds it: try another
             u.close()
+            t.close()
             continue
         socks += [u, t]
         ports.append(port)

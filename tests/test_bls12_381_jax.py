@@ -1,0 +1,139 @@
+"""BLS12-381 device kernels vs the scalar oracle.
+
+The second device curve (ops/pairing.py `BLS12Pairing`,
+models/bls12_381_jax.py) validated bit-exactly against
+ops/bls12_381_ref.py — same strategy as tests/test_pairing_jax.py: shared
+B=4 shapes so every graph compiles once into the persistent cache.
+
+Where the reference offers two interchangeable BN256 backends
+(bn256/go/bn256.go, bn256/cf/bn256.go), this framework offers two device
+curves behind one Constructor registry (simul/lib/config.go:211-225).
+"""
+
+import random
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from handel_tpu.ops import bls12_381_ref as bls
+from handel_tpu.ops.curve import BLS12Curves
+from handel_tpu.ops.pairing import BLS12Pairing
+
+B = 4  # lane count shared by every test
+
+
+@pytest.fixture(scope="module")
+def stack():
+    curves = BLS12Curves()
+    return curves, BLS12Pairing(curves)
+
+
+def _rand_points(seed):
+    rng = random.Random(seed)
+    ks = [rng.randrange(1, bls.R) for _ in range(B)]
+    ls = [rng.randrange(1, bls.R) for _ in range(B)]
+    g1s = [bls.g1_mul(bls.G1_GEN, k) for k in ks]
+    g2s = [bls.g2_mul(bls.G2_GEN, l) for l in ls]
+    return ks, ls, g1s, g2s
+
+
+def _pack_pairs(curves, g1s, g2s):
+    xp = curves.F.pack([p[0] for p in g1s])
+    yp = curves.F.pack([p[1] for p in g1s])
+    xq = curves.T.f2_pack([q[0] for q in g2s])
+    yq = curves.T.f2_pack([q[1] for q in g2s])
+    return (xp, yp), (xq, yq)
+
+
+def test_curve_ops_match_oracle(stack):
+    curves, _ = stack
+    _, _, g1s, g2s = _rand_points(2)
+    P = curves.pack_g1(g1s)
+    assert curves.unpack_g1(curves.g1.double(P)) == [
+        bls.g1_add(p, p) for p in g1s
+    ]
+    Q = curves.pack_g2(g2s)
+    assert curves.unpack_g2(curves.g2.add(Q, Q)) == [
+        bls.g2_add(q, q) for q in g2s
+    ]
+    assert np.asarray(curves.g1.on_curve(P)).all()
+    assert np.asarray(curves.g2.on_curve(Q)).all()
+
+
+def test_pairing_matches_oracle(stack):
+    curves, pr = stack
+    _, _, g1s, g2s = _rand_points(3)
+    p, q = _pack_pairs(curves, g1s, g2s)
+    f = jax.jit(lambda p, q: pr.miller_loop(p, q))(p, q)
+    got = curves.T.f12_unpack(f)
+    exp = [bls.miller_loop(q_, p_) for p_, q_ in zip(g1s, g2s)]
+    assert got == exp
+    e = jax.jit(pr.final_exp)(f)
+    assert curves.T.f12_unpack(e) == [bls.final_exponentiation(x) for x in exp]
+
+
+def test_pairing_check_bls_verify(stack):
+    """e(H, X_j) * e(-S_j, B2) == 1 for valid BLS signatures; corrupt lane
+    rejected (bls12_381_ref.pairing_check device form)."""
+    curves, pr = stack
+    rng = random.Random(11)
+    F, T = curves.F, curves.T
+    h = bls.g1_mul(bls.G1_GEN, rng.randrange(1, bls.R))  # H(m)
+    sks = [rng.randrange(1, bls.R) for _ in range(B)]
+    pks = [bls.g2_mul(bls.G2_GEN, sk) for sk in sks]
+    sigs = [bls.g1_mul(h, sk) for sk in sks]
+    sigs[B - 1] = bls.g1_mul(bls.G1_GEN, 777)  # corrupt last lane
+
+    px = F.pack([h[0]] * B + [bls.g1_neg(s)[0] for s in sigs])
+    py = F.pack([h[1]] * B + [bls.g1_neg(s)[1] for s in sigs])
+    qx = T.f2_pack([pk[0] for pk in pks] + [bls.G2_GEN[0]] * B)
+    qy = T.f2_pack([pk[1] for pk in pks] + [bls.G2_GEN[1]] * B)
+    mask = jnp.ones((2 * B,), bool)
+    verdicts = np.asarray(
+        jax.jit(lambda p, q, m: pr.pairing_check(p, q, m, B))(
+            (px, py), (qx, qy), mask
+        )
+    )
+    assert verdicts.tolist() == [True] * (B - 1) + [False]
+
+
+@pytest.mark.slow
+def test_device_scheme_batch_verify():
+    """models/bls12_381_jax.py end-to-end: host keygen/sign, device verify
+    through the Constructor interface (batch of 4: 3 valid + 1 forged)."""
+    from handel_tpu.core.bitset import BitSet
+    from handel_tpu.models.bls12_381 import BLS12381Signature, new_keypair
+    from handel_tpu.models.bls12_381_jax import BLS12381JaxConstructor
+
+    rng = random.Random(13)
+    N = 8
+    keys = [new_keypair(seed=i) for i in range(N)]
+    pks = [pk for _, pk in keys]
+    msg = b"bls12-381 device e2e"
+    reqs, expect = [], []
+    for j in range(B):
+        signers = sorted(rng.sample(range(N), rng.randrange(2, N)))
+        bs = BitSet(N)
+        sig = None
+        for i in signers:
+            bs.set(i, True)
+            s = keys[i][0].sign(msg)
+            sig = s if sig is None else sig.combine(s)
+        if j == B - 1:
+            sig = BLS12381Signature(bls.g1_mul(bls.G1_GEN, 12345))
+            expect.append(False)
+        else:
+            expect.append(True)
+        reqs.append((bs, sig))
+    cons = BLS12381JaxConstructor(batch_size=B)
+    assert cons.batch_verify(msg, pks, reqs) == expect
+
+
+def test_scheme_registry_dispatch():
+    from handel_tpu.models.registry import new_scheme
+
+    scheme = new_scheme("bls12-381-jax", batch_size=4)
+    sk, pk = scheme.keygen(0)
+    assert scheme.unmarshal_public(pk.marshal()).point == pk.point
