@@ -17,9 +17,8 @@ from __future__ import annotations
 
 from handel_tpu.models.bls12_381 import (
     BLS12381Constructor,
-    BLS12381PublicKey,
+    BLS12381Scheme,
     hash_to_g1,
-    new_keypair,
 )
 from handel_tpu.models.bn254_jax import BN254Device, BN254JaxConstructor
 from handel_tpu.ops import bls12_381_ref as bls
@@ -46,21 +45,9 @@ class BLS12381JaxConstructor(BLS12381Constructor, BN254JaxConstructor):
         BN254JaxConstructor.__init__(self, batch_size=batch_size, curves=curves)
 
 
-class BLS12381JaxScheme:
-    """Keygen facade for harness/simulation use (host keygen, device verify)."""
+class BLS12381JaxScheme(BLS12381Scheme):
+    """Keygen facade for harness/simulation use: the host scheme's keygen and
+    wire formats with the device-verification constructor swapped in."""
 
     def __init__(self, batch_size: int = 16):
         self.constructor = BLS12381JaxConstructor(batch_size=batch_size)
-
-    def keygen(self, i: int):
-        return new_keypair(seed=i)
-
-    def unmarshal_public(self, data: bytes) -> BLS12381PublicKey:
-        from handel_tpu.models.bls12_381 import unmarshal_g2
-
-        return BLS12381PublicKey(unmarshal_g2(data))
-
-    def unmarshal_secret(self, data: bytes):
-        from handel_tpu.models.bls12_381 import BLS12381SecretKey
-
-        return BLS12381SecretKey.unmarshal(data)

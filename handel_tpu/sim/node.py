@@ -130,10 +130,25 @@ async def run_node_process(args) -> int:
             return await h.final_signatures.get()
         return await h.final  # gossip baseline
 
-    finals = await asyncio.wait_for(
-        asyncio.gather(*(one_done(h) for _, h, _ in handels)),
-        timeout=cfg.max_timeout_s,
-    )
+    try:
+        finals = await asyncio.wait_for(
+            asyncio.gather(*(one_done(h) for _, h, _ in handels)),
+            timeout=cfg.max_timeout_s,
+        )
+    except asyncio.TimeoutError:
+        # stall diagnostics: per-node progress is the only evidence a
+        # multi-process deadlock leaves behind
+        for nid, h, net in handels:
+            best = getattr(h, "store", None) and h.store.full_signature()
+            card = best.cardinality() if best else 0
+            vals = net.values() if hasattr(net, "values") else {}
+            print(
+                f"node {nid}: STALLED at {card}/{threshold} "
+                f"(sent={vals.get('sentPackets')} rcvd={vals.get('rcvdPackets')} "
+                f"dropped={vals.get('droppedPackets')})",
+                file=sys.stderr,
+            )
+        raise
 
     ok = True
     for (nid, h, net), ms, m in zip(handels, finals, measures):

@@ -22,23 +22,66 @@ from handel_tpu.sim.monitor import Monitor
 from handel_tpu.sim.sync import STATE_END, STATE_START, SyncMaster
 
 
+# the kernel's ephemeral source-port range: ports returned by bind(0) live
+# here, so a released probe port can be re-grabbed as the SOURCE port of any
+# connected socket (sync slaves, monitor sinks) before its intended process
+# binds it — at 256+ node sockets per run that race is near-certain. Probing
+# sequentially OUTSIDE the range closes it.
+def _probe_window() -> tuple[int, int] | None:
+    """(lo, hi) port window disjoint from the ephemeral range, or None when
+    the configured range leaves no usable window (degrade to bind(0))."""
+    eph_lo, eph_hi = 32768, 60999
+    try:
+        with open("/proc/sys/net/ipv4/ip_local_port_range") as f:
+            eph_lo, eph_hi = (int(x) for x in f.read().split()[:2])
+    except (OSError, ValueError):
+        pass
+    if eph_lo - 10000 >= 4096:  # enough room below the range
+        return (max(10000, eph_lo - 22768), eph_lo)
+    if 65536 - (eph_hi + 1) >= 2048:  # room above it
+        return (eph_hi + 1, 65536)
+    return None
+
+
+_WINDOW = _probe_window()
+# offset the start per process so concurrent runs on one host don't probe
+# the same sequence (each still verifies by binding)
+_probe_cursor = [
+    _WINDOW[0] + (os.getpid() * 37) % ((_WINDOW[1] - _WINDOW[0]) // 2)
+    if _WINDOW
+    else 0
+]
+
+
 def free_ports(n: int) -> list[int]:
-    """simul/lib/net.go:13-52. Each port is probed as BOTH udp and tcp so the
-    result is usable by either transport family."""
+    """simul/lib/net.go:13-52, hardened for single-host scale: sequential
+    ports outside the ephemeral range, each probed as BOTH udp and tcp so the
+    result is usable by either transport family. All probe sockets are held
+    until the full set is allocated. Falls back to kernel-chosen ports when
+    the ephemeral range covers everything (pathological sysctl)."""
     socks, ports = [], []
+    port = _probe_cursor[0]
     while len(ports) < n:
         u = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-        u.bind(("127.0.0.1", 0))
-        port = u.getsockname()[1]
         t = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         try:
-            t.bind(("127.0.0.1", port))
-        except OSError:  # a tcp listener already holds it: try another
+            if _WINDOW is None:  # no disjoint window: old bind(0) behavior
+                u.bind(("127.0.0.1", 0))
+                t.bind(("127.0.0.1", u.getsockname()[1]))
+            else:
+                if port >= _WINDOW[1]:
+                    port = _WINDOW[0]  # wrap
+                u.bind(("127.0.0.1", port))
+                t.bind(("127.0.0.1", port))
+        except OSError:  # something holds it: try the next port
             u.close()
             t.close()
+            port += 1
             continue
         socks += [u, t]
-        ports.append(port)
+        ports.append(u.getsockname()[1])
+        port += 1
+    _probe_cursor[0] = port  # successive allocations advance, not reuse
     for s in socks:
         s.close()
     return ports
@@ -115,9 +158,18 @@ class LocalhostPlatform:
                     )
                 )
 
-            await sync.wait_all(STATE_START, cfg.max_timeout_s)
-            await sync.wait_all(STATE_END, cfg.max_timeout_s)
-
+            timed_out = False
+            try:
+                await sync.wait_all(STATE_START, cfg.max_timeout_s)
+                await sync.wait_all(STATE_END, cfg.max_timeout_s)
+            except asyncio.TimeoutError:
+                # a node died or stalled before signaling: kill the tree but
+                # REAP the children and keep their output — the only
+                # diagnostics a multi-process stall leaves behind
+                timed_out = True
+                for p in procs:
+                    if p.returncode is None:
+                        p.kill()
             outs = await asyncio.gather(*(p.communicate() for p in procs))
             rcs = [p.returncode for p in procs]
         finally:
@@ -136,8 +188,10 @@ class LocalhostPlatform:
         }
         csv_path = os.path.join(self.dir, f"results_{run_index}.csv")
         monitor.stats.write_csv(csv_path)
-        ok = all(rc == 0 for rc in rcs) and all(
-            b"finished OK" in out for out, _ in outs
+        ok = (
+            not timed_out
+            and all(rc == 0 for rc in rcs)
+            and all(b"finished OK" in out for out, _ in outs)
         )
         return RunResult(ok=ok, csv_path=csv_path, outputs=outs, returncodes=rcs)
 

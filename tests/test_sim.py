@@ -254,3 +254,30 @@ def test_evaluator_knob_roundtrip(tmp_path):
 
     c = back.runs[0].handel.to_config(5, seed=1)
     assert c.new_processing is FifoProcessing
+
+
+@pytest.mark.slow
+def test_localhost_platform_256_nodes(tmp_path):
+    """Reference-scale single-host run: 256 nodes, 8 processes, 99%
+    threshold. Regression for the free_ports ephemeral-range race that
+    deadlocked runs past ~128 sockets (platform.py free_ports)."""
+    from handel_tpu.sim.platform import run_simulation
+
+    cfg = SimConfig(
+        network="udp",
+        scheme="fake",
+        max_timeout_s=120.0,
+        runs=[
+            RunConfig(
+                nodes=256,
+                threshold=254,
+                processes=8,
+                handel=HandelParams(period_ms=50.0, timeout_ms=100.0),
+            )
+        ],
+    )
+    results = asyncio.run(run_simulation(cfg, str(tmp_path)))
+    assert results[0].ok, [e.decode(errors="replace")[-2000:] for _, e in results[0].outputs]
+    rows = list(csv.DictReader(open(results[0].csv_path)))
+    assert float(rows[0]["nodes"]) == 256
+    assert float(rows[0]["sigen_wall_avg"]) > 0
