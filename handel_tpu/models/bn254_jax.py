@@ -33,9 +33,9 @@ from handel_tpu.core.bitset import BitSet
 from handel_tpu.models.bn254 import (
     BN254Constructor,
     BN254PublicKey,
+    BN254Scheme,
     BN254Signature,
     hash_to_g1,
-    new_keypair,
 )
 from handel_tpu.ops import bn254_ref as bn
 from handel_tpu.ops.curve import BN254Curves
@@ -373,14 +373,13 @@ class BN254JaxConstructor(BN254Constructor):
         return self._device_of(pubkeys).batch_verify(msg, requests)
 
 
-class BN254JaxScheme:
-    """Keygen facade for harness/simulation use (host keygen, device verify)."""
+class BN254JaxScheme(BN254Scheme):
+    """Keygen facade for harness/simulation use: the host scheme's keygen and
+    wire formats (incl. unmarshal_public/unmarshal_secret for the registry
+    CSV) with the device-verification constructor swapped in."""
 
     def __init__(self, batch_size: int = 16):
         self.constructor = BN254JaxConstructor(batch_size=batch_size)
-
-    def keygen(self, i: int):
-        return new_keypair(seed=i)
 
 
 def make_async_verifier(device: BN254Device):

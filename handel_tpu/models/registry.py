@@ -34,3 +34,20 @@ def new_scheme(name: str, **kwargs):
 
 
 SCHEMES = ("fake", "bn254", "bn254-jax", "bls12-381", "bls12-381-jax")
+
+_DEVICE_NAMES = frozenset(
+    (
+        "bn254-jax",
+        "bn254-tpu",
+        "bn256-tpu",
+        "bls12-381-jax",
+        "bls12-381-tpu",
+        "bls12381-jax",
+    )
+)
+
+
+def is_device_scheme(name: str) -> bool:
+    """True when `name` selects a device-verification scheme (one whose
+    constructor accepts batch_size and exposes a Device class)."""
+    return name.lower() in _DEVICE_NAMES

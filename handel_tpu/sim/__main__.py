@@ -12,6 +12,10 @@ import argparse
 import asyncio
 import sys
 
+from handel_tpu.utils.jaxenv import apply_platform_env
+
+apply_platform_env()  # before anything can import jax
+
 from handel_tpu.sim.config import load_config
 from handel_tpu.sim.platform import run_simulation
 

@@ -18,9 +18,13 @@ import argparse
 import asyncio
 import sys
 
+from handel_tpu.utils.jaxenv import apply_platform_env
+
+apply_platform_env()  # before anything can import jax
+
 from handel_tpu.core.crypto import verify_multisignature
 from handel_tpu.core.handel import Handel
-from handel_tpu.models.registry import new_scheme
+from handel_tpu.models.registry import is_device_scheme, new_scheme
 from handel_tpu.network.encoding import CounterEncoding
 from handel_tpu.network.udp import UDPNetwork
 from handel_tpu.network.tcp import TCPNetwork
@@ -36,7 +40,10 @@ MSG = b"handel-tpu simulation message"
 async def run_node_process(args) -> int:
     cfg = load_config(args.config)
     run = cfg.runs[args.run]
-    scheme = new_scheme(cfg.scheme)
+    scheme = new_scheme(
+        cfg.scheme,
+        **({"batch_size": cfg.batch_size} if is_device_scheme(cfg.scheme) else {}),
+    )
     records = simkeys.read_registry_csv(args.registry)
     registry = simkeys.registry_from_records(records, scheme)
     ids = [int(x) for x in args.ids.split(",") if x != ""]
@@ -47,13 +54,17 @@ async def run_node_process(args) -> int:
     # one transport per logical node, bound to its registry address
     nets, handels = [], []
     shared_service = None
-    if cfg.shared_verifier and cfg.scheme.endswith("jax") and not cfg.baseline:
-        from handel_tpu.models.bn254_jax import BN254Device
+    if (
+        cfg.shared_verifier
+        and hasattr(scheme.constructor, "Device")
+        and not cfg.baseline
+    ):
         from handel_tpu.parallel.batch_verifier import BatchVerifierService
 
-        device = BN254Device(
-            registry.public_keys(), batch_size=cfg.batch_size
-        )
+        # prepare() builds the device for this scheme's curve family AND
+        # caches it on the constructor, so per-node constructor.batch_verify
+        # calls reuse the same registry upload + executables
+        device = scheme.constructor.prepare(registry.public_keys())
         shared_service = BatchVerifierService(device)
 
     for nid in ids:

@@ -1,0 +1,37 @@
+"""Process-level JAX platform selection for CLI entry points.
+
+The environment may pre-register an experimental TPU platform plugin at
+interpreter startup via a sitecustomize that calls
+`jax.config.update("jax_platforms", ...)` — which OVERRIDES the
+JAX_PLATFORMS environment variable (see tests/conftest.py). Simulation node
+processes usually want the CPU backend (the TPU is the bench host's, and a
+downed TPU tunnel makes jax initialization hang forever), so the sim entry
+points call `apply_platform_env()` before anything imports jax-dependent
+modules: it re-overrides through the config API, which wins over any
+earlier update.
+
+Knob: HANDEL_TPU_PLATFORM=cpu|tpu|axon|"" (empty/unset = leave alone).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def apply_platform_env(default: str | None = None) -> None:
+    """Force the JAX platform from $HANDEL_TPU_PLATFORM (or `default`)."""
+    plat = os.environ.get("HANDEL_TPU_PLATFORM", default or "")
+    if not plat:
+        return
+    os.environ["JAX_PLATFORMS"] = plat
+    import jax
+
+    jax.config.update("jax_platforms", plat)
+    jax.config.update("jax_compilation_cache_dir", "/tmp/handel_tpu_jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    from jax._src import xla_bridge as xb
+
+    if xb.backends_are_initialized():
+        from jax.extend.backend import clear_backends
+
+        clear_backends()

@@ -34,3 +34,54 @@ def test_bn254_end_to_end():
     for sig in results.values():
         assert sig.cardinality() >= cluster.threshold
         assert verify_multisignature(MSG, sig, cluster.registry, scheme.constructor)
+
+
+@pytest.mark.slow
+def test_bn254_jax_device_end_to_end():
+    """The protocol with verification ON THE DEVICE PATH: an 8-node cluster
+    whose Constructor.batch_verify runs the batched aggregation +
+    product-of-pairings launch (models/bn254_jax.py) — the wiring the whole
+    framework exists for (VERDICT r1 item 2)."""
+    from handel_tpu.models.bn254_jax import BN254JaxScheme
+
+    scheme = BN254JaxScheme(batch_size=8)
+
+    async def go():
+        cluster = LocalCluster(8, scheme=scheme, msg=MSG)
+        cluster.start()
+        try:
+            return cluster, await cluster.wait_complete_success(timeout=900.0)
+        finally:
+            cluster.stop()
+
+    cluster, results = asyncio.run(go())
+    assert len(results) == 8
+    for sig in results.values():
+        assert sig.cardinality() >= cluster.threshold
+        assert verify_multisignature(
+            MSG, sig, cluster.registry, scheme.constructor
+        )
+
+
+@pytest.mark.slow
+def test_bls12_381_jax_device_end_to_end():
+    """Same protocol wiring on the second device curve (bls12-381-jax)."""
+    from handel_tpu.models.bls12_381_jax import BLS12381JaxScheme
+
+    scheme = BLS12381JaxScheme(batch_size=8)
+
+    async def go():
+        cluster = LocalCluster(8, scheme=scheme, msg=MSG)
+        cluster.start()
+        try:
+            return cluster, await cluster.wait_complete_success(timeout=900.0)
+        finally:
+            cluster.stop()
+
+    cluster, results = asyncio.run(go())
+    assert len(results) == 8
+    for sig in results.values():
+        assert sig.cardinality() >= cluster.threshold
+        assert verify_multisignature(
+            MSG, sig, cluster.registry, scheme.constructor
+        )

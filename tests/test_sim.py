@@ -281,3 +281,36 @@ def test_localhost_platform_256_nodes(tmp_path):
     rows = list(csv.DictReader(open(results[0].csv_path)))
     assert float(rows[0]["nodes"]) == 256
     assert float(rows[0]["sigen_wall_avg"]) > 0
+
+
+@pytest.mark.slow
+def test_localhost_platform_bn254_jax_shared_verifier(tmp_path, monkeypatch):
+    """Simulation with verification on the device path: scheme bn254-jax +
+    the shared BatchVerifierService fusing co-located nodes' requests into
+    one launch per batch (sim/node.py scheme.constructor.Device dispatch).
+    Node subprocesses force the CPU backend via HANDEL_TPU_PLATFORM (a downed
+    TPU tunnel would otherwise hang jax init in every child)."""
+    from handel_tpu.sim.platform import run_simulation
+
+    monkeypatch.setenv("HANDEL_TPU_PLATFORM", "cpu")
+    cfg = SimConfig(
+        network="udp",
+        scheme="bn254-jax",
+        batch_size=8,
+        shared_verifier=True,
+        max_timeout_s=900.0,
+        runs=[
+            RunConfig(
+                nodes=8,
+                threshold=5,
+                processes=1,
+                handel=HandelParams(period_ms=20.0),
+            )
+        ],
+    )
+    results = asyncio.run(run_simulation(cfg, str(tmp_path)))
+    assert results[0].ok, [
+        e.decode(errors="replace")[-2000:] for _, e in results[0].outputs
+    ]
+    rows = list(csv.DictReader(open(results[0].csv_path)))
+    assert float(rows[0]["sigs_sigCheckedCt_avg"]) > 0
