@@ -2,52 +2,75 @@
 
 Reference: simul/lib/config.go:211-225 (`Config.NewConstructor`: "bn256",
 "bn256/cf", "bn256/go"). Here the names select both keygen and the verify
-path; "bn254-jax" is the device-verification scheme.
+path; the "-jax" schemes verify on device.
+
+One table holds every alias: canonical name -> (is_device, factory). Keeping
+`is_device_scheme` and `new_scheme` on the same table means a new alias
+can't silently miss the batch-size plumbing in sim/node.py.
 """
 
 from __future__ import annotations
 
 
-def new_scheme(name: str, **kwargs):
-    name = name.lower()
-    if name in ("fake", "empty"):
-        from handel_tpu.models.fake import FakeScheme
+def _fake(**kw):
+    from handel_tpu.models.fake import FakeScheme
 
-        return FakeScheme()
-    if name in ("bn254", "bn256", "bn254-ref"):
-        from handel_tpu.models.bn254 import BN254Scheme
+    return FakeScheme()
 
-        return BN254Scheme()
-    if name in ("bn254-jax", "bn254-tpu", "bn256-tpu"):
-        from handel_tpu.models.bn254_jax import BN254JaxScheme
 
-        return BN254JaxScheme(**kwargs)
-    if name in ("bls12-381", "bls12381"):
-        from handel_tpu.models.bls12_381 import BLS12381Scheme
+def _bn254(**kw):
+    from handel_tpu.models.bn254 import BN254Scheme
 
-        return BLS12381Scheme()
-    if name in ("bls12-381-jax", "bls12-381-tpu", "bls12381-jax"):
-        from handel_tpu.models.bls12_381_jax import BLS12381JaxScheme
+    return BN254Scheme()
 
-        return BLS12381JaxScheme(**kwargs)
-    raise ValueError(f"unknown signature scheme: {name!r}")
 
+def _bn254_jax(**kw):
+    from handel_tpu.models.bn254_jax import BN254JaxScheme
+
+    return BN254JaxScheme(**kw)
+
+
+def _bls12_381(**kw):
+    from handel_tpu.models.bls12_381 import BLS12381Scheme
+
+    return BLS12381Scheme()
+
+
+def _bls12_381_jax(**kw):
+    from handel_tpu.models.bls12_381_jax import BLS12381JaxScheme
+
+    return BLS12381JaxScheme(**kw)
+
+
+# alias -> (is_device_scheme, factory)
+_TABLE = {
+    "fake": (False, _fake),
+    "empty": (False, _fake),
+    "bn254": (False, _bn254),
+    "bn256": (False, _bn254),
+    "bn254-ref": (False, _bn254),
+    "bn254-jax": (True, _bn254_jax),
+    "bn254-tpu": (True, _bn254_jax),
+    "bn256-tpu": (True, _bn254_jax),
+    "bls12-381": (False, _bls12_381),
+    "bls12381": (False, _bls12_381),
+    "bls12-381-jax": (True, _bls12_381_jax),
+    "bls12-381-tpu": (True, _bls12_381_jax),
+    "bls12381-jax": (True, _bls12_381_jax),
+}
 
 SCHEMES = ("fake", "bn254", "bn254-jax", "bls12-381", "bls12-381-jax")
 
-_DEVICE_NAMES = frozenset(
-    (
-        "bn254-jax",
-        "bn254-tpu",
-        "bn256-tpu",
-        "bls12-381-jax",
-        "bls12-381-tpu",
-        "bls12381-jax",
-    )
-)
+
+def new_scheme(name: str, **kwargs):
+    entry = _TABLE.get(name.lower())
+    if entry is None:
+        raise ValueError(f"unknown signature scheme: {name!r}")
+    return entry[1](**kwargs)
 
 
 def is_device_scheme(name: str) -> bool:
     """True when `name` selects a device-verification scheme (one whose
     constructor accepts batch_size and exposes a Device class)."""
-    return name.lower() in _DEVICE_NAMES
+    entry = _TABLE.get(name.lower())
+    return bool(entry and entry[0])

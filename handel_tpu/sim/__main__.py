@@ -12,10 +12,6 @@ import argparse
 import asyncio
 import sys
 
-from handel_tpu.utils.jaxenv import apply_platform_env
-
-apply_platform_env()  # before anything can import jax
-
 from handel_tpu.sim.config import load_config
 from handel_tpu.sim.platform import run_simulation
 
@@ -24,9 +20,11 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", required=True)
     ap.add_argument("--workdir", default="sim_out")
+    # platform dispatch (simul/main.go -platform flag)
+    ap.add_argument("--platform", default="localhost")
     args = ap.parse_args()
     cfg = load_config(args.config)
-    results = asyncio.run(run_simulation(cfg, args.workdir))
+    results = asyncio.run(run_simulation(cfg, args.workdir, platform=args.platform))
     ok = all(r.ok for r in results)
     for i, r in enumerate(results):
         status = "success" if r.ok else "FAILED"

@@ -18,10 +18,6 @@ import argparse
 import asyncio
 import sys
 
-from handel_tpu.utils.jaxenv import apply_platform_env
-
-apply_platform_env()  # before anything can import jax
-
 from handel_tpu.core.crypto import verify_multisignature
 from handel_tpu.core.handel import Handel
 from handel_tpu.models.registry import is_device_scheme, new_scheme
@@ -40,6 +36,12 @@ MSG = b"handel-tpu simulation message"
 async def run_node_process(args) -> int:
     cfg = load_config(args.config)
     run = cfg.runs[args.run]
+    if is_device_scheme(cfg.scheme):
+        # select the JAX backend BEFORE the scheme module imports jax;
+        # fake/host schemes never touch jax at all
+        from handel_tpu.utils.jaxenv import apply_platform_env
+
+        apply_platform_env()
     scheme = new_scheme(
         cfg.scheme,
         **({"batch_size": cfg.batch_size} if is_device_scheme(cfg.scheme) else {}),

@@ -14,7 +14,7 @@ import os
 import socket
 import sys
 
-from handel_tpu.models.registry import new_scheme
+from handel_tpu.models.registry import is_device_scheme, new_scheme
 from handel_tpu.sim import keys as simkeys
 from handel_tpu.sim.allocator import new_allocator
 from handel_tpu.sim.config import SimConfig, dump_config
@@ -101,6 +101,12 @@ class LocalhostPlatform:
     async def start_run(self, run_index: int) -> "RunResult":
         cfg = self.cfg
         run = cfg.runs[run_index]
+        if is_device_scheme(cfg.scheme):
+            # select the JAX backend before the scheme module imports jax
+            # (a downed TPU tunnel would otherwise hang keygen forever)
+            from handel_tpu.utils.jaxenv import apply_platform_env
+
+            apply_platform_env()
         scheme = new_scheme(cfg.scheme)
 
         # ports: node addresses + master + monitor
@@ -204,9 +210,22 @@ class RunResult:
         self.returncodes = returncodes
 
 
-async def run_simulation(cfg: SimConfig, workdir: str) -> list[RunResult]:
+def new_platform(name: str, cfg: SimConfig, workdir: str):
+    """Platform dispatch (simul/platform/platform.go:59 NewPlatform:
+    "localhost" | "aws"). The cloud slot ("gke"/"tpu-pod": cross-host deploy
+    with the standalone master, sim/master.py) is reserved — the per-host
+    pieces (node binary, sync slaves, monitor sinks over DCN addresses)
+    already run standalone; what a cloud platform adds is only provisioning."""
+    if name == "localhost":
+        return LocalhostPlatform(cfg, workdir)
+    raise ValueError(f"unknown platform {name!r} (available: localhost)")
+
+
+async def run_simulation(
+    cfg: SimConfig, workdir: str, platform: str = "localhost"
+) -> list[RunResult]:
     """Orchestrator: run every RunConfig sequentially (simul/main.go:24-68)."""
-    plat = LocalhostPlatform(cfg, workdir)
+    plat = new_platform(platform, cfg, workdir)
     results = []
     for i in range(len(cfg.runs)):
         res = None

@@ -1,37 +1,56 @@
-"""Process-level JAX platform selection for CLI entry points.
+"""Process-level JAX platform selection — the ONE copy of the ritual.
 
 The environment may pre-register an experimental TPU platform plugin at
 interpreter startup via a sitecustomize that calls
 `jax.config.update("jax_platforms", ...)` — which OVERRIDES the
-JAX_PLATFORMS environment variable (see tests/conftest.py). Simulation node
-processes usually want the CPU backend (the TPU is the bench host's, and a
-downed TPU tunnel makes jax initialization hang forever), so the sim entry
-points call `apply_platform_env()` before anything imports jax-dependent
-modules: it re-overrides through the config API, which wins over any
-earlier update.
+JAX_PLATFORMS environment variable. Re-overriding through the config API
+(which wins over any earlier update) and clearing already-initialized
+backends is the only reliable selection; tests/conftest.py and
+__graft_entry__.py delegate here.
 
-Knob: HANDEL_TPU_PLATFORM=cpu|tpu|axon|"" (empty/unset = leave alone).
+Knob: HANDEL_TPU_PLATFORM=cpu|tpu|axon (any name jax accepts; this
+environment's TPU platform is "axon"). Unset/empty = leave the platform
+alone. Calling this imports jax, so sim entry points only call it when the
+run's scheme actually needs jax (registry.is_device_scheme) — fake-scheme
+protocol runs never touch jax.
 """
 
 from __future__ import annotations
 
 import os
 
+CACHE_DIR = "/tmp/handel_tpu_jax_cache"
 
-def apply_platform_env(default: str | None = None) -> None:
-    """Force the JAX platform from $HANDEL_TPU_PLATFORM (or `default`)."""
+
+def apply_platform_env(
+    default: str | None = None, force_host_device_count: int | None = None
+) -> None:
+    """Force the JAX platform from $HANDEL_TPU_PLATFORM (or `default`).
+
+    force_host_device_count: also expose that many virtual devices on the
+    host platform (the 8-device CPU mesh used by tests and dryrun) — must be
+    set before jax initializes its backends.
+    """
     plat = os.environ.get("HANDEL_TPU_PLATFORM", default or "")
     if not plat:
         return
     os.environ["JAX_PLATFORMS"] = plat
+    if force_host_device_count is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags
+                + f" --xla_force_host_platform_device_count={force_host_device_count}"
+            ).strip()
     import jax
 
     jax.config.update("jax_platforms", plat)
-    jax.config.update("jax_compilation_cache_dir", "/tmp/handel_tpu_jax_cache")
+    # persistent compile cache: pairing-sized graphs take minutes cold
+    jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     from jax._src import xla_bridge as xb
 
-    if xb.backends_are_initialized():
+    if xb.backends_are_initialized():  # a plugin already built a backend set
         from jax.extend.backend import clear_backends
 
         clear_backends()

@@ -7,32 +7,19 @@ sharding/mesh tests exercise real multi-device code paths
 The environment may pre-register an experimental TPU platform plugin at
 interpreter startup (a sitecustomize that calls
 `jax.config.update("jax_platforms", ...)`), which overrides the JAX_PLATFORMS
-environment variable — so setting the env var is NOT enough. We re-override
-through the config API, which wins over any earlier update, and clear any
-already-initialized backends so the CPU selection actually engages.
+environment variable — so setting the env var is NOT enough. The shared
+helper (handel_tpu/utils/jaxenv.py) re-overrides through the config API,
+which wins over any earlier update, and clears any already-initialized
+backends so the CPU selection actually engages.
 This must run before any test imports jax-dependent modules.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# force CPU even if the caller exported HANDEL_TPU_PLATFORM=tpu: test
+# correctness must be checkable on any chip-less machine
+os.environ["HANDEL_TPU_PLATFORM"] = "cpu"
 
-import jax
+from handel_tpu.utils.jaxenv import apply_platform_env
 
-jax.config.update("jax_platforms", "cpu")
-# persistent compile cache: pairing-sized graphs take tens of seconds to
-# compile on CPU the first time; reruns hit the disk cache
-jax.config.update("jax_compilation_cache_dir", "/tmp/handel_tpu_jax_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-
-from jax._src import xla_bridge as _xb
-
-if _xb.backends_are_initialized():  # a plugin already built a backend set
-    from jax.extend.backend import clear_backends
-
-    clear_backends()
+apply_platform_env(default="cpu", force_host_device_count=8)
