@@ -17,8 +17,30 @@ to a reduced CPU-sized problem so the line is always emitted).
 from __future__ import annotations
 
 import json
+import os
 import random
+import subprocess
+import sys
 import time
+
+
+def _probe_default_backend(timeout_s: float = 90.0) -> bool:
+    """True if jax can initialize its default platform within the timeout.
+
+    The environment's TPU is reached through a tunnel whose outage makes
+    `import jax` + device init hang FOREVER (not error). Probing in a
+    subprocess keeps this process safe; on failure the bench falls back to
+    CPU so the driver always records a line.
+    """
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s,
+            capture_output=True,
+        )
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
 
 
 def build_problem(curves, n_registry: int, lanes: int, n_candidates: int):
@@ -49,8 +71,12 @@ def build_problem(curves, n_registry: int, lanes: int, n_candidates: int):
         size = rng.choice([n_registry // 8, n_registry // 4, n_registry // 2])
         lo[j] = rng.randrange(0, n_registry - size)
         hi[j] = lo[j] + size
+        max_holes = min(miss_k, size - 1)  # leave at least one signer
         holes = sorted(
-            rng.sample(range(int(lo[j]), int(hi[j])), rng.randrange(0, miss_k))
+            rng.sample(
+                range(int(lo[j]), int(hi[j])),
+                rng.randrange(0, max_holes) if max_holes > 0 else 0,
+            )
         )
         miss_idx[: len(holes), j] = holes
         miss_ok[: len(holes), j] = True
@@ -80,6 +106,15 @@ def build_problem(curves, n_registry: int, lanes: int, n_candidates: int):
 
 
 def main() -> None:
+    from handel_tpu.utils.jaxenv import apply_platform_env
+
+    if not os.environ.get("HANDEL_TPU_PLATFORM") and not _probe_default_backend():
+        # TPU tunnel down: force CPU through the config API (the env var
+        # alone is overridden by the environment's sitecustomize)
+        os.environ["HANDEL_TPU_PLATFORM"] = "cpu"
+        print("bench: default backend unreachable, falling back to CPU",
+              file=sys.stderr)
+    apply_platform_env()  # no-op when HANDEL_TPU_PLATFORM is unset
     import jax
 
     jax.config.update("jax_compilation_cache_dir", "/tmp/handel_tpu_jax_cache")
