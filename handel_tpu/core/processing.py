@@ -22,6 +22,7 @@ keys as a masked segment-sum over the dense on-device registry array.
 from __future__ import annotations
 
 import asyncio
+import heapq
 import time
 from typing import Awaitable, Callable, Protocol, Sequence
 
@@ -113,6 +114,11 @@ class BatchProcessing:
         self.filter: Filter = IndividualSigFilter()
         self.max_retries = 3  # per-candidate verifier-error retry budget
 
+        # priority queue of (-score, seq, sig): scored once at enqueue, lazily
+        # re-scored at dequeue (see _select_batch). `_todos` stays a plain
+        # list for the FIFO subclass, unused by the heap path.
+        self._heap: list[tuple[int, int, IncomingSig]] = []
+        self._seq = 0
         self._todos: list[IncomingSig] = []
         self._wakeup = asyncio.Event()
         self._task: asyncio.Task | None = None
@@ -139,14 +145,37 @@ class BatchProcessing:
         if self._stopped:
             return
         if self.filter.accept(sp):
-            self._todos.append(sp)
-            self._wakeup.set()
+            self._enqueue(sp)
+            if self._queue_len():
+                self._wakeup.set()
+
+    def _enqueue(self, sp: IncomingSig) -> None:
+        """Score once and push; worthless candidates die at the door
+        (the reference prunes score-0 todos on every pass,
+        processing.go:171-220 — here they are pruned at enqueue and again
+        at dequeue, never verified)."""
+        if sp.ms is None:
+            self.sig_suppressed += 1
+            return
+        mark = self.evaluator.evaluate(sp)
+        if mark <= 0:
+            self.sig_suppressed += 1
+            return
+        self._seq += 1
+        heapq.heappush(self._heap, (-mark, self._seq, sp))
+
+    def _queue_len(self) -> int:
+        return len(self._heap)
+
+    def pending(self) -> list[IncomingSig]:
+        """Snapshot of queued candidates (test/introspection hook)."""
+        return [sp for _, _, sp in self._heap]
 
     # -- processing loop ---------------------------------------------------
 
     async def _loop(self) -> None:
         while not self._stopped:
-            if not self._todos:
+            if not self._queue_len():
                 self._wakeup.clear()
                 await self._wakeup.wait()
                 continue
@@ -156,27 +185,41 @@ class BatchProcessing:
             await self._verify_and_publish(batch)
 
     def _select_batch(self) -> list[IncomingSig]:
-        """Score all pending sigs, drop the worthless, take the top batch.
+        """Pop the best-scored candidates, re-scoring lazily.
 
-        The reference's readTodos (processing.go:171-220) selects exactly one
-        best; here the top `batch_size` go to the device together.
+        The reference's readTodos (processing.go:171-220) re-scores the WHOLE
+        queue per pick — O(queue) Python per step melts at a 4000-node flood.
+        Here enqueue-time scores order the heap and only popped entries are
+        re-scored against the current store: a popped entry whose fresh score
+        fell below the next queued score is pushed back (once per step, which
+        bounds the loop) instead of stealing a batch slot. Store updates only
+        ever *lower* a pending score in the common path (levels complete,
+        bitsets get dominated), so the stale keys are upper bounds and the
+        order matches the reference's; the rare raise (a new individual sig
+        patches more holes than the enqueue-time score knew) costs only
+        ordering, never a lost verification.
         """
-        previous_len = len(self._todos)
-        scored = []
-        for sp in self._todos:
-            if sp.ms is None:
+        batch: list[IncomingSig] = []
+        pushed_back: set[int] = set()
+        while self._heap and len(batch) < self.batch_size:
+            neg, seq, sp = heapq.heappop(self._heap)
+            fresh = self.evaluator.evaluate(sp) if sp.ms is not None else 0
+            if fresh <= 0:
+                self.sig_suppressed += 1
                 continue
-            mark = self.evaluator.evaluate(sp)
-            if mark > 0:
-                scored.append((mark, sp))
-        scored.sort(key=lambda t: t[0], reverse=True)
-        batch = [sp for _, sp in scored[: self.batch_size]]
-        self._todos = [sp for _, sp in scored[self.batch_size :]]
+            if (
+                fresh < -neg
+                and seq not in pushed_back
+                and self._heap
+                and -self._heap[0][0] > fresh
+            ):
+                pushed_back.add(seq)
+                heapq.heappush(self._heap, (-fresh, seq, sp))
+                continue
+            batch.append(sp)
 
-        kept = len(self._todos)
-        self.sig_suppressed += previous_len - kept - len(batch)
         self.sig_checked_ct += len(batch)
-        self.sig_queue_size += kept
+        self.sig_queue_size += len(self._heap)
         return batch
 
     async def _verify_and_publish(self, batch: list[IncomingSig]) -> None:
@@ -226,13 +269,13 @@ class BatchProcessing:
             sp.verify_tries += 1
             tries = sp.verify_tries
             if tries <= self.max_retries:
-                self._todos.append(sp)
+                self._enqueue(sp)
             else:
                 self.log.error(
                     "verify_retries_exhausted",
                     f"origin={sp.origin} level={sp.level} tries={tries}",
                 )
-        if self._todos:
+        if self._queue_len():
             self._wakeup.set()
 
     def _global_bitset(self, sp: IncomingSig) -> BitSet:
@@ -275,9 +318,22 @@ class FifoProcessing(BatchProcessing):
     verified in full.
     """
 
+    def _enqueue(self, sp: IncomingSig) -> None:
+        self._todos.append(sp)
+
+    def _queue_len(self) -> int:
+        return len(self._todos)
+
+    def pending(self) -> list[IncomingSig]:
+        return list(self._todos)
+
     def _select_batch(self) -> list[IncomingSig]:
-        batch = [sp for sp in self._todos[: self.batch_size] if sp.ms is not None]
-        self._todos = self._todos[self.batch_size :]
+        # drop ms-less entries up front so they neither consume batch slots
+        # nor escape the suppressed counter
+        usable = [sp for sp in self._todos if sp.ms is not None]
+        self.sig_suppressed += len(self._todos) - len(usable)
+        batch = usable[: self.batch_size]
+        self._todos = usable[self.batch_size :]
         self.sig_checked_ct += len(batch)
         self.sig_queue_size += len(self._todos)
         return batch

@@ -443,8 +443,19 @@ class Tower:
         return acc
 
     def f12_pow_u(self, a, cyclo: bool = False):
-        """a^U for the BN parameter U (BN254 tower only)."""
-        return self.f12_pow_const(a, self.params.U, cyclo=cyclo)
+        """a^U for the BN parameter U (BN254 tower only).
+
+        BLS parameter sets define no U (they expose X instead and override
+        final_exp entirely), so fail loudly rather than with an opaque
+        AttributeError mid-trace."""
+        U = getattr(self.params, "U", None)
+        if U is None:
+            raise TypeError(
+                f"f12_pow_u needs a BN parameter set with U; "
+                f"{type(self.params).__name__} has none (BLS towers use "
+                f"their own final-exp chain)"
+            )
+        return self.f12_pow_const(a, U, cyclo=cyclo)
 
     # -- host conversions ---------------------------------------------------
 

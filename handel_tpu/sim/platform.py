@@ -61,7 +61,19 @@ def free_ports(n: int) -> list[int]:
     the ephemeral range covers everything (pathological sysctl)."""
     socks, ports = [], []
     port = _probe_cursor[0]
+    probes = 0
+    max_probes = (_WINDOW[1] - _WINDOW[0]) if _WINDOW else 0
     while len(ports) < n:
+        if _WINDOW is not None and probes >= max_probes + n:
+            # one full pass over the window without filling the request:
+            # every port is occupied (or n exceeds the window) — fail with a
+            # diagnosable error instead of spinning forever
+            for s in socks:
+                s.close()
+            raise OSError(
+                f"free_ports: no {n} free ports in window {_WINDOW} "
+                f"after {probes} probes ({len(ports)} found)"
+            )
         u = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         t = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         try:
@@ -77,6 +89,7 @@ def free_ports(n: int) -> list[int]:
             u.close()
             t.close()
             port += 1
+            probes += 1
             continue
         socks += [u, t]
         ports.append(u.getsockname()[1])

@@ -185,11 +185,71 @@ def test_requeue_retry_cap():
         proc.stop()
         assert sp.verify_tries == proc.max_retries + 1
         assert not verified
-        assert not proc._todos or all(
-            s.verify_tries <= proc.max_retries for s in proc._todos
-        )
+        assert all(s.verify_tries <= proc.max_retries for s in proc.pending())
 
     run(go())
+
+
+def test_heap_priority_and_lazy_suppression():
+    """The priority queue verifies higher-scored candidates first and a
+    candidate whose score drops to 0 after enqueue is pruned at dequeue
+    (the lazy re-score replacing the reference's whole-queue rescan,
+    processing.go:171-220)."""
+    from handel_tpu.core.bitset import BitSet
+    from handel_tpu.core.crypto import MultiSignature
+    from handel_tpu.core.identity import ArrayRegistry, Identity
+    from handel_tpu.core.partitioner import BinomialPartitioner, IncomingSig
+    from handel_tpu.core.processing import BatchProcessing
+    from handel_tpu.models.fake import FakePublic, FakeSignature
+
+    async def go():
+        reg = ArrayRegistry(
+            [Identity(i, f"x-{i}", FakePublic(True)) for i in range(8)]
+        )
+        part = BinomialPartitioner(0, reg)
+        scores = {1: 5, 2: 9, 3: 3}
+        verified_order = []
+
+        class Eval:
+            def evaluate(self, sp):
+                return scores[sp.origin]
+
+        async def record(msg, pubkeys, requests):
+            return [True] * len(requests)
+
+        proc = BatchProcessing(
+            part,
+            FakeConstructor(),
+            b"m",
+            [None] * 8,
+            Eval(),
+            lambda sp: verified_order.append(sp.origin),
+            batch_size=1,
+            verifier=record,
+        )
+        proc.start()
+        for origin in (1, 2, 3):
+            bs = BitSet(1)
+            bs.set(0)
+            proc.add(
+                IncomingSig(
+                    origin=origin,
+                    level=1,
+                    ms=MultiSignature(bs, FakeSignature()),
+                )
+            )
+        # origin 2 goes stale before the loop ever runs a step
+        scores[2] = 0
+        for _ in range(50):
+            await asyncio.sleep(0.01)
+            if len(verified_order) >= 2:
+                break
+        proc.stop()
+        return verified_order, proc.sig_suppressed
+
+    order, suppressed = run(go())
+    assert order == [1, 3]  # priority order among survivors (5 > 3)
+    assert suppressed >= 1  # the stale origin-2 entry died at dequeue
 
 
 def test_fifo_processing_cluster():
