@@ -75,6 +75,12 @@ class Config:
     # shared async batch-verify service (parallel/batch_verifier.py); None
     # means verify through the scheme's own batch_verify
     verifier: Optional[Callable] = None
+    # device-mesh width for the verification plane: >1 routes the device
+    # scheme's kernels through the shard_map'd variants (registry-sharded
+    # G2 sum, candidate-sharded pairing check, parallel/sharding.py; sizes
+    # that don't divide the mesh are padded with masked lanes). Consumed at
+    # scheme construction (models/bn254_jax.py BN254Device, sim/node.py)
+    mesh_devices: int = 1
 
 
 def default_config(num_nodes: int) -> Config:

@@ -77,6 +77,34 @@ class KernelTimer:
         }
 
 
+class CurveCheckCounters:
+    """Process-wide counters for host-side point-validation cost.
+
+    The G2 subgroup check (a scalar-mult by r on every pubkey-bearing
+    unmarshal, models/bn254.py) is the biggest host-CPU item on the packet/
+    registry-load path; without a counter a large-N run can't attribute its
+    host time. models/{bn254,bls12_381}.py feed the shared instance below;
+    sim/node.py reports it through the monitor plane."""
+
+    def __init__(self):
+        self.g2_checks = 0
+        self.g2_time_ms = 0.0
+
+    def add_g2(self, dt_ms: float) -> None:
+        self.g2_checks += 1
+        self.g2_time_ms += dt_ms
+
+    def values(self) -> dict[str, float]:
+        return {
+            "g2SubgroupChecks": float(self.g2_checks),
+            "g2SubgroupCheckTimeMs": self.g2_time_ms,
+        }
+
+
+#: the per-process instance every curve backend feeds
+SUBGROUP_CHECKS = CurveCheckCounters()
+
+
 def diff_values(
     before: Mapping[str, float], after: Mapping[str, float]
 ) -> dict[str, float]:

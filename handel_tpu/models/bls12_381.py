@@ -15,7 +15,9 @@ from __future__ import annotations
 
 import hashlib
 import secrets
+import time
 
+from handel_tpu.core import report
 from handel_tpu.core.crypto import Constructor
 from handel_tpu.ops import bls12_381_ref as bls
 
@@ -66,7 +68,10 @@ def unmarshal_g2(data: bytes):
         return None
     x1, x0, y1, y0 = (_btoi(data[i : i + _COORD]) for i in range(0, _G2_SIZE, _COORD))
     pt = ((x0, x1), (y0, y1))
-    if not bls.g2_is_valid(pt):
+    t0 = time.perf_counter()
+    ok = bls.g2_is_valid(pt)
+    report.SUBGROUP_CHECKS.add_g2((time.perf_counter() - t0) * 1000.0)
+    if not ok:
         raise ValueError("G2 point not on curve / wrong subgroup")
     return pt
 

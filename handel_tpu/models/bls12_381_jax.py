@@ -41,13 +41,22 @@ class BLS12381JaxConstructor(BLS12381Constructor, BN254JaxConstructor):
 
     Device = BLS12381Device
 
-    def __init__(self, batch_size: int = 16, curves: BLS12Curves | None = None):
-        BN254JaxConstructor.__init__(self, batch_size=batch_size, curves=curves)
+    def __init__(
+        self,
+        batch_size: int = 16,
+        curves: BLS12Curves | None = None,
+        mesh_devices: int = 1,
+    ):
+        BN254JaxConstructor.__init__(
+            self, batch_size=batch_size, curves=curves, mesh_devices=mesh_devices
+        )
 
 
 class BLS12381JaxScheme(BLS12381Scheme):
     """Keygen facade for harness/simulation use: the host scheme's keygen and
     wire formats with the device-verification constructor swapped in."""
 
-    def __init__(self, batch_size: int = 16):
-        self.constructor = BLS12381JaxConstructor(batch_size=batch_size)
+    def __init__(self, batch_size: int = 16, mesh_devices: int = 1):
+        self.constructor = BLS12381JaxConstructor(
+            batch_size=batch_size, mesh_devices=mesh_devices
+        )

@@ -26,8 +26,10 @@ from __future__ import annotations
 
 import hashlib
 import secrets
+import time
 
 from handel_tpu import native as nat
+from handel_tpu.core import report
 from handel_tpu.core.crypto import Constructor
 from handel_tpu.ops import bn254_ref as bn
 
@@ -83,9 +85,14 @@ def unmarshal_g2(data: bytes, check_subgroup: bool = True):
     if not bn.pt_is_on_curve(bn.F2_OPS, pt, bn.TWIST_B):
         raise ValueError("G2 point not on curve")
     # subgroup check [r]P == O on the native path (the Python oracle's
-    # g2_is_valid does the same mul ~15x slower — hot in packet unmarshal)
-    if check_subgroup and nat.g2_mul(pt, bn.R) is not None:
-        raise ValueError("G2 point not on curve / wrong subgroup")
+    # g2_is_valid does the same mul ~15x slower — hot in packet unmarshal);
+    # counted on the shared plane so large-N runs can attribute host time
+    if check_subgroup:
+        t0 = time.perf_counter()
+        bad = nat.g2_mul(pt, bn.R) is not None
+        report.SUBGROUP_CHECKS.add_g2((time.perf_counter() - t0) * 1000.0)
+        if bad:
+            raise ValueError("G2 point not on curve / wrong subgroup")
     return pt
 
 

@@ -63,6 +63,7 @@ class BN254Device:
         registry_pubkeys: Sequence[BN254PublicKey],
         batch_size: int = 16,
         curves: BN254Curves | None = None,
+        mesh_devices: int = 1,
     ):
         self.curves = curves or self.Curves()
         self.pairing = self.Pairing(self.curves)
@@ -74,6 +75,37 @@ class BN254Device:
             raise ValueError("registry public keys must be valid G2 points")
         self._reg_x = T.f2_pack([p[0] for p in pts])  # ((L, N), (L, N))
         self._reg_y = T.f2_pack([p[1] for p in pts])
+        # multi-chip plane (SURVEY.md §5.7): registry shards over the mesh
+        # for the masked G2 segment-sum, candidate lanes shard for the
+        # pairing check. Same host entry points — `_one_launch` dispatches to
+        # a STAGED pipeline of separate executables (sharded sum / range
+        # aggregation -> affine epilogue -> sharded pairing check) instead of
+        # the single-device monolithic kernels: nesting shard_map regions
+        # inside the big jit sends XLA's partitioner over the whole pairing
+        # graph, which takes hours on a 1-core host (parallel/sharding.py
+        # module docstring has the measurement).
+        self.mesh_devices = mesh_devices
+        self.mesh = None
+        self._sharded_sum = self._sharded_check = None
+        if mesh_devices > 1:
+            from handel_tpu.parallel.sharding import (
+                make_mesh,
+                sharded_masked_sum_g2,
+                sharded_pairing_check,
+            )
+
+            self.mesh = make_mesh(mesh_devices)
+            self._sharded_sum = sharded_masked_sum_g2(
+                self.curves, self.mesh, self.n, batch_size
+            )
+            self._sharded_check = sharded_pairing_check(
+                self.pairing, self.mesh, batch_size
+            )
+            self._affine_kernel = jax.jit(self.curves.g2.to_affine)
+            self._neg_kernel = jax.jit(self.curves.F.neg)
+            self._b2x = T.f2_pack([self.ref.G2_GEN[0]])
+            self._b2y = T.f2_pack([self.ref.G2_GEN[1]])
+            self._range_agg_kernels: dict[int, callable] = {}
         self._h_cache: dict[bytes, tuple] = {}
         # prefix table: slot i = sum of registry keys [0, i) in affine, with
         # an explicit infinity flag (slot 0). Built lazily on the first
@@ -141,6 +173,7 @@ class BN254Device:
             jnp.broadcast_to(b2[1][1], qy[0].shape),
         )
         neg_sig_y = F.neg(sig_y)
+        ok_lane = valid & ~agg_inf
         px = jnp.concatenate([jnp.broadcast_to(h_x, sig_x.shape), sig_x], axis=1)
         py = jnp.concatenate([jnp.broadcast_to(h_y, sig_y.shape), neg_sig_y], axis=1)
         qx2 = (
@@ -151,7 +184,6 @@ class BN254Device:
             jnp.concatenate([qy[0], by[0]], axis=1),
             jnp.concatenate([qy[1], by[1]], axis=1),
         )
-        ok_lane = valid & ~agg_inf
         lane_mask = jnp.concatenate([ok_lane, ok_lane])
         checks = self.pairing.pairing_check((px, py), (qx2, qy2), lane_mask, C)
         return checks & ok_lane
@@ -183,20 +215,10 @@ class BN254Device:
         P = g2.from_affine((take(x0), take(x1)), (take(y0), take(y1)))
         return g2.select(jnp.take(inf, idx), g2.infinity(idx.shape[0]), P)
 
-    def _verify_batch_range(
-        self, lo, hi, miss_idx, miss_ok, sig_x, sig_y, h_x, h_y, valid, miss_k
-    ):
-        """Range-candidate launch: per-candidate aggregate key =
-        prefix[hi] - prefix[lo] - sum(missing signers in the hull).
-
-        The O(1)-per-candidate path for Handel traffic, where every
-        candidate's signer set is an ID range of the binomial partitioner
-        (partitioner.go rangeLevel) minus a few offline members. lo/hi: (C,)
-        indices into the prefix table; miss_idx/miss_ok: (miss_k*C,)
-        block-major registry indices + validity for the subtraction patch.
-        """
+    def _range_aggregate(self, lo, hi, miss_idx, miss_ok, miss_k):
+        """Per-candidate aggregate key (projective) =
+        prefix[hi] - prefix[lo] - sum(missing signers in the hull)."""
         g2 = self.curves.g2
-        C = self.batch_size
         hull = g2.add(self._gather_prefix(hi), g2.neg(self._gather_prefix(lo)))
         if miss_k:
             take = lambda a: jnp.take(a, miss_idx, axis=1)
@@ -206,7 +228,56 @@ class BN254Device:
             )
             msum = g2.masked_sum(Pm, miss_ok, miss_k)
             hull = g2.add(hull, g2.neg(msum))
+        return hull
+
+    def _verify_batch_range(
+        self, lo, hi, miss_idx, miss_ok, sig_x, sig_y, h_x, h_y, valid, miss_k
+    ):
+        """Range-candidate launch: per-candidate aggregate key via the prefix
+        table — the O(1)-per-candidate path for Handel traffic, where every
+        candidate's signer set is an ID range of the binomial partitioner
+        (partitioner.go rangeLevel) minus a few offline members. lo/hi: (C,)
+        indices into the prefix table; miss_idx/miss_ok: (miss_k*C,)
+        block-major registry indices + validity for the subtraction patch.
+        """
+        hull = self._range_aggregate(lo, hi, miss_idx, miss_ok, miss_k)
         return self._pairing_tail(hull, sig_x, sig_y, h_x, h_y, valid)
+
+    # -- staged sharded pipeline (mesh_devices > 1) -------------------------
+
+    def _range_agg_kernel(self, miss_k: int):
+        """Range aggregation alone as its own executable: point adds only,
+        no pairing — compiles in seconds and keeps the mesh out of the
+        monolithic jit."""
+        _ = self._prefix
+        fn = self._range_agg_kernels.get(miss_k)
+        if fn is None:
+            fn = jax.jit(partial(self._range_aggregate, miss_k=miss_k))
+            self._range_agg_kernels[miss_k] = fn
+        return fn
+
+    def _sharded_tail(self, agg, sig_x, sig_y, h_x, h_y, valid):
+        """Affine epilogue + candidate-sharded product-of-pairings, staged
+        as separate executables with host glue (the structure the dryrun
+        validated; see the __init__ comment for why not one jit)."""
+        qx, qy, inf = self._affine_kernel(agg)
+        ok = np.asarray(valid) & ~np.asarray(inf)
+        hxb = jnp.broadcast_to(h_x, sig_x.shape)
+        hyb = jnp.broadcast_to(h_y, sig_y.shape)
+        neg_y = self._neg_kernel(sig_y)
+        shape = qx[0].shape
+        bx = (
+            jnp.broadcast_to(self._b2x[0], shape),
+            jnp.broadcast_to(self._b2x[1], shape),
+        )
+        by = (
+            jnp.broadcast_to(self._b2y[0], shape),
+            jnp.broadcast_to(self._b2y[1], shape),
+        )
+        checks = self._sharded_check(
+            ((hxb, hyb), (sig_x, neg_y)), ((qx, qy), (bx, by)), jnp.asarray(ok)
+        )
+        return np.asarray(checks) & ok
 
     def _range_kernel(self, miss_k: int):
         # materialize the prefix table HERE, on the host, before jit runs:
@@ -297,32 +368,48 @@ class BN254Device:
                 )
                 miss_idx[: missing.size, j] = missing
                 miss_ok[: missing.size, j] = True
-            verdicts = self._range_kernel(miss_k)(
+            range_args = (
                 jnp.asarray(lo),
                 jnp.asarray(hi),
                 jnp.asarray(miss_idx.reshape(-1)),
                 jnp.asarray(miss_ok.reshape(-1)),
-                sig_x,
-                sig_y,
-                h_x,
-                h_y,
-                jnp.asarray(valid),
             )
+            if self.mesh is not None:
+                agg = self._range_agg_kernel(miss_k)(*range_args)
+                verdicts = self._sharded_tail(
+                    agg, sig_x, sig_y, h_x, h_y, jnp.asarray(valid)
+                )
+            else:
+                verdicts = self._range_kernel(miss_k)(
+                    *range_args, sig_x, sig_y, h_x, h_y, jnp.asarray(valid)
+                )
         else:
             mask = np.zeros((self.n, C), dtype=bool)
             for j, idx in enumerate(sets):
                 if valid[j] and idx.size:
                     mask[idx, j] = True
-            verdicts = self._kernel(
-                self._reg_x,
-                self._reg_y,
-                jnp.asarray(mask.reshape(-1)),
-                sig_x,
-                sig_y,
-                h_x,
-                h_y,
-                jnp.asarray(valid),
-            )
+            if self.mesh is not None:
+                agg = self._sharded_sum(
+                    self._reg_x[0],
+                    self._reg_x[1],
+                    self._reg_y[0],
+                    self._reg_y[1],
+                    jnp.asarray(mask),
+                )
+                verdicts = self._sharded_tail(
+                    agg, sig_x, sig_y, h_x, h_y, jnp.asarray(valid)
+                )
+            else:
+                verdicts = self._kernel(
+                    self._reg_x,
+                    self._reg_y,
+                    jnp.asarray(mask.reshape(-1)),
+                    sig_x,
+                    sig_y,
+                    h_x,
+                    h_y,
+                    jnp.asarray(valid),
+                )
         return [bool(v) for v in np.asarray(verdicts)[: len(requests)]]
 
 
@@ -336,15 +423,24 @@ class BN254JaxConstructor(BN254Constructor):
 
     Device = BN254Device
 
-    def __init__(self, batch_size: int = 16, curves: BN254Curves | None = None):
+    def __init__(
+        self,
+        batch_size: int = 16,
+        curves: BN254Curves | None = None,
+        mesh_devices: int = 1,
+    ):
         self.batch_size = batch_size
+        self.mesh_devices = mesh_devices
         self.curves = curves or self.Device.Curves()
         self._device: BN254Device | None = None
         self._device_for: int | None = None
 
     def prepare(self, pubkeys: Sequence[BN254PublicKey]) -> BN254Device:
         self._device = self.Device(
-            pubkeys, batch_size=self.batch_size, curves=self.curves
+            pubkeys,
+            batch_size=self.batch_size,
+            curves=self.curves,
+            mesh_devices=self.mesh_devices,
         )
         # hold the list itself: the id() cache key below is only valid while
         # the original object is alive (id reuse after GC would alias a new
@@ -378,8 +474,10 @@ class BN254JaxScheme(BN254Scheme):
     wire formats (incl. unmarshal_public/unmarshal_secret for the registry
     CSV) with the device-verification constructor swapped in."""
 
-    def __init__(self, batch_size: int = 16):
-        self.constructor = BN254JaxConstructor(batch_size=batch_size)
+    def __init__(self, batch_size: int = 16, mesh_devices: int = 1):
+        self.constructor = BN254JaxConstructor(
+            batch_size=batch_size, mesh_devices=mesh_devices
+        )
 
 
 def make_async_verifier(device: BN254Device):

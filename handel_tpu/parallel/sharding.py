@@ -24,7 +24,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from handel_tpu.ops.curve import BN254Curves
@@ -34,6 +34,12 @@ from handel_tpu.ops.pairing import BN254Pairing
 def make_mesh(n_devices: int | None = None, axis: str = "dp") -> Mesh:
     devs = jax.devices()
     n = n_devices or len(devs)
+    if n > len(devs):
+        raise ValueError(
+            f"mesh of {n} devices requested but only {len(devs)} visible "
+            f"(platform {devs[0].platform}); for CPU tests set "
+            f"xla_force_host_platform_device_count"
+        )
     return Mesh(np.array(devs[:n]), (axis,))
 
 
@@ -51,9 +57,11 @@ def sharded_masked_sum_g2(
     """
     g2 = curves.g2
     ndev = mesh.shape[axis]
-    if n_registry % ndev:
-        raise ValueError("registry size must divide evenly over the mesh")
-    local_n = n_registry // ndev
+    # non-divisible registries (4000 nodes on 8 chips) are padded up to the
+    # next multiple with edge-replicated points masked out of every sum —
+    # callers never see the padding
+    pad_n = (-n_registry) % ndev
+    local_n = (n_registry + pad_n) // ndev
 
     def local_block(reg_x0, reg_x1, reg_y0, reg_y1, mask):
         # shapes here are per-device: (L, local_n), (local_n, batch)
@@ -102,9 +110,18 @@ def sharded_masked_sum_g2(
             P(axis, None),
         ),
         out_specs=P(),  # combined point replicated on every device
-        check_rep=False,
+        check_vma=False,
     )
-    return jax.jit(fn)
+
+    def padded(reg_x0, reg_x1, reg_y0, reg_y1, mask):
+        if pad_n:
+            pad_pt = lambda a: jnp.pad(a, ((0, 0), (0, pad_n)), mode="edge")
+            reg_x0, reg_x1 = pad_pt(reg_x0), pad_pt(reg_x1)
+            reg_y0, reg_y1 = pad_pt(reg_y0), pad_pt(reg_y1)
+            mask = jnp.pad(mask, ((0, pad_n), (0, 0)))  # padded rows: False
+        return fn(reg_x0, reg_x1, reg_y0, reg_y1, mask)
+
+    return jax.jit(padded)
 
 
 def sharded_pairing_check(
@@ -122,9 +139,9 @@ def sharded_pairing_check(
     committed sharding — shard_map's in_specs repartition them.
     """
     ndev = mesh.shape[axis]
-    if groups % ndev:
-        raise ValueError("candidate count must divide evenly over the mesh")
-    local = groups // ndev
+    # non-divisible candidate counts are padded with masked-out lanes
+    pad_g = (-groups) % ndev
+    local = (groups + pad_g) // ndev
 
     def body(ps, qs, mask):
         # build the local chunk-major lane layout: lane i*local + j holds
@@ -147,6 +164,16 @@ def sharded_pairing_check(
         mesh=mesh,
         in_specs=(P(None, axis), P(None, axis), P(axis)),
         out_specs=P(axis),
-        check_rep=False,
+        check_vma=False,
     )
-    return jax.jit(fn)
+
+    def padded(ps, qs, mask):
+        if pad_g:
+            pad_pt = lambda a: jnp.pad(a, ((0, 0), (0, pad_g)), mode="edge")
+            ps = jax.tree_util.tree_map(pad_pt, ps)
+            qs = jax.tree_util.tree_map(pad_pt, qs)
+            mask = jnp.pad(mask, (0, pad_g))  # padded lanes: invalid
+        out = fn(ps, qs, mask)
+        return out[:groups] if pad_g else out
+
+    return jax.jit(padded)

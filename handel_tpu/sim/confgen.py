@@ -114,7 +114,8 @@ def scenario_nsquare() -> SimConfig:
 
 
 def scenario_gossipsub() -> SimConfig:
-    """Mesh-gossip baseline matrix (libp2p scenario)."""
+    """Gossipsub baseline matrix (libp2p scenario): per-topic meshes,
+    GRAFT/PRUNE, IHAVE/IWANT — baselines/gossipsub.py."""
     return SimConfig(
         network="udp",
         scheme="bn254",

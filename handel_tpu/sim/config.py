@@ -74,6 +74,17 @@ class RunConfig:
 
 
 @dataclass
+class HostSpec:
+    """One host of the remote platform's fleet (sim/remote.py; the analog
+    of an aws.go instance entry)."""
+
+    connect: str = "local"  # "local" | "ssh:<user@host>"
+    ip: str = "127.0.0.1"  # address other nodes dial this host's nodes at
+    python: str = ""  # remote python executable ("" = this interpreter)
+    workdir: str = ""  # staging dir on the host ("" = per-host tmp dir)
+
+
+@dataclass
 class SimConfig:
     network: str = "udp"  # udp | tcp | inproc
     scheme: str = "bn254"
@@ -83,10 +94,17 @@ class SimConfig:
     retrials: int = 1
     batch_size: int = 16
     shared_verifier: bool = False
+    # device-mesh width for the verification plane (>1 = sharded kernels;
+    # on chip-less hosts virtual CPU devices are forced to this count)
+    mesh_devices: int = 1
     debug: bool = False
     # "" = Handel; "nsquare" / "gossipsub" select the comparison baselines
     # (simul/p2p; here handel_tpu/baselines/gossip.py)
     baseline: str = ""
+    # -- remote platform (sim/remote.py; aws.go analog) --------------------
+    hosts: list[HostSpec] = field(default_factory=list)
+    master_ip: str = "127.0.0.1"  # address remote nodes dial the master at
+    base_port: int = 0  # node port base; 0 = probe locally (all-local only)
     runs: list[RunConfig] = field(default_factory=list)
 
 
@@ -102,9 +120,21 @@ def load_config(path: str) -> SimConfig:
         retrials=int(raw.get("retrials", 1)),
         batch_size=int(raw.get("batch_size", 16)),
         shared_verifier=bool(raw.get("shared_verifier", False)),
+        mesh_devices=int(raw.get("mesh_devices", 1)),
         debug=bool(raw.get("debug", False)),
         baseline=str(raw.get("baseline", "")),
+        master_ip=str(raw.get("master_ip", "127.0.0.1")),
+        base_port=int(raw.get("base_port", 0)),
     )
+    for h in raw.get("hosts", []):
+        cfg.hosts.append(
+            HostSpec(
+                connect=str(h.get("connect", "local")),
+                ip=str(h.get("ip", "127.0.0.1")),
+                python=str(h.get("python", "")),
+                workdir=str(h.get("workdir", "")),
+            )
+        )
     for r in raw.get("runs", []):
         h = r.get("handel", {})
         cfg.runs.append(
@@ -139,9 +169,21 @@ def dump_config(cfg: SimConfig) -> str:
         f"retrials = {cfg.retrials}",
         f"batch_size = {cfg.batch_size}",
         f"shared_verifier = {str(cfg.shared_verifier).lower()}",
+        f"mesh_devices = {cfg.mesh_devices}",
         f"debug = {str(cfg.debug).lower()}",
         f'baseline = "{cfg.baseline}"',
+        f'master_ip = "{cfg.master_ip}"',
+        f"base_port = {cfg.base_port}",
     ]
+    for h in cfg.hosts:
+        lines += [
+            "",
+            "[[hosts]]",
+            f'connect = "{h.connect}"',
+            f'ip = "{h.ip}"',
+            f'python = "{h.python}"',
+            f'workdir = "{h.workdir}"',
+        ]
     for r in cfg.runs:
         lines += [
             "",

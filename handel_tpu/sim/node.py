@@ -38,20 +38,40 @@ async def run_node_process(args) -> int:
     run = cfg.runs[args.run]
     if is_device_scheme(cfg.scheme):
         # select the JAX backend BEFORE the scheme module imports jax;
-        # fake/host schemes never touch jax at all
+        # fake/host schemes never touch jax at all. mesh_devices > 1 on a
+        # chip-less host needs that many virtual CPU devices
         from handel_tpu.utils.jaxenv import apply_platform_env
 
-        apply_platform_env()
+        apply_platform_env(
+            force_host_device_count=(
+                cfg.mesh_devices if cfg.mesh_devices > 1 else None
+            )
+        )
     scheme = new_scheme(
         cfg.scheme,
-        **({"batch_size": cfg.batch_size} if is_device_scheme(cfg.scheme) else {}),
+        **(
+            {"batch_size": cfg.batch_size, "mesh_devices": cfg.mesh_devices}
+            if is_device_scheme(cfg.scheme)
+            else {}
+        ),
     )
-    records = simkeys.read_registry_csv(args.registry)
-    registry = simkeys.registry_from_records(records, scheme)
     ids = [int(x) for x in args.ids.split(",") if x != ""]
     threshold = run.resolved_threshold()
 
     sink = Sink(args.monitor) if args.monitor else None
+    # process-wide batch-plane telemetry (SURVEY.md §5.1): G2 subgroup-check
+    # cost (which starts accruing at registry load, right below), shared
+    # launch fill ratio and device wall time added once the service exists.
+    # Snapshot BEFORE the registry unmarshals so startup cost is attributed.
+    plane = device_meas = None
+    if sink:
+        from handel_tpu.core.report import SUBGROUP_CHECKS, ReportAggregator
+
+        plane = ReportAggregator(subgroup=SUBGROUP_CHECKS)
+        device_meas = CounterIO(sink, "device", plane)
+
+    records = simkeys.read_registry_csv(args.registry)
+    registry = simkeys.registry_from_records(records, scheme)
 
     # one transport per logical node, bound to its registry address
     nets, handels = [], []
@@ -61,13 +81,21 @@ async def run_node_process(args) -> int:
         and hasattr(scheme.constructor, "Device")
         and not cfg.baseline
     ):
+        from handel_tpu.core.report import KernelTimer
         from handel_tpu.parallel.batch_verifier import BatchVerifierService
 
         # prepare() builds the device for this scheme's curve family AND
         # caches it on the constructor, so per-node constructor.batch_verify
         # calls reuse the same registry upload + executables
         device = scheme.constructor.prepare(registry.public_keys())
+        # kernel-time trace hook (SURVEY.md §5.1): every shared launch's
+        # wall time lands on the monitor plane
+        launch_timer = KernelTimer(device.batch_verify, name="launch")
+        device.batch_verify = launch_timer
         shared_service = BatchVerifierService(device)
+        if plane is not None:
+            plane.add("verifier", shared_service)
+            plane.add("launch", launch_timer)
 
     for nid in ids:
         rec = records[nid]
@@ -83,10 +111,10 @@ async def run_node_process(args) -> int:
         sk = simkeys.secret_of(rec, scheme)
         if cfg.baseline:  # comparison protocols (simul/p2p shared binary)
             from handel_tpu.baselines.gossip import GossipAggregator
-            from handel_tpu.baselines.gossipsub import MeshGossipAggregator
+            from handel_tpu.baselines.gossipsub import GossipSubAggregator
 
             agg_cls, kw = (
-                (MeshGossipAggregator, {})
+                (GossipSubAggregator, {})
                 if cfg.baseline == "gossipsub"
                 else (GossipAggregator, {"connector": "full"})
             )
@@ -103,6 +131,7 @@ async def run_node_process(args) -> int:
         else:
             hconf = run.handel.to_config(threshold, seed=nid)
             hconf.batch_size = cfg.batch_size
+            hconf.mesh_devices = cfg.mesh_devices
             if shared_service is not None:
                 hconf.verifier = shared_service.verify
             h = Handel(
@@ -127,13 +156,14 @@ async def run_node_process(args) -> int:
     )
 
     measures = []
-    for nid, h, net in handels:
+    for idx, (nid, h, net) in enumerate(handels):
         if sink:
             sig_counters = h.proc if hasattr(h, "proc") else h  # gossip: self
-            measures.append(
-                (TimeMeasure(sink, "sigen"), CounterIO(sink, "net", net),
-                 CounterIO(sink, "sigs", sig_counters))
-            )
+            ms = [TimeMeasure(sink, "sigen"), CounterIO(sink, "net", net),
+                  CounterIO(sink, "sigs", sig_counters)]
+            if idx == 0 and device_meas is not None:
+                ms.append(device_meas)  # batch plane: once per process
+            measures.append(tuple(ms))
         else:
             measures.append(None)
         h.start()

@@ -225,13 +225,20 @@ class RunResult:
 
 def new_platform(name: str, cfg: SimConfig, workdir: str):
     """Platform dispatch (simul/platform/platform.go:59 NewPlatform:
-    "localhost" | "aws"). The cloud slot ("gke"/"tpu-pod": cross-host deploy
-    with the standalone master, sim/master.py) is reserved — the per-host
-    pieces (node binary, sync slaves, monitor sinks over DCN addresses)
-    already run standalone; what a cloud platform adds is only provisioning."""
+    "localhost" | "aws"). "remote" is the aws analog (sim/remote.py):
+    ship the package to a host list (ssh or localhost-as-remote), start node
+    processes there, run the barriers from this process. Cloud provisioning
+    (the Terraform layer) stays out of scope — a GKE/TPU-pod runner is
+    `platform=remote` plus an externally provisioned host list."""
     if name == "localhost":
         return LocalhostPlatform(cfg, workdir)
-    raise ValueError(f"unknown platform {name!r} (available: localhost)")
+    if name == "remote":
+        from handel_tpu.sim.remote import RemotePlatform
+
+        return RemotePlatform(cfg, workdir)
+    raise ValueError(
+        f"unknown platform {name!r} (available: localhost, remote)"
+    )
 
 
 async def run_simulation(

@@ -92,11 +92,33 @@ def plot_failing(csvs: dict[str, str], out: str):
     return _plot_xy(series, "failing nodes", "aggregation time (s)", out)
 
 
+def plot_batch_plane(csvs: dict[str, str], out: str):
+    """Batch-plane telemetry vs committee size: shared-launch occupancy,
+    device wall time per launch, and host G2 subgroup-check time — the
+    columns sim/node.py's `device` CounterIO records. Attributes where a
+    large-N run's time goes (host unmarshal vs device launches)."""
+    series = []
+    for label, path in csvs.items():
+        rows = read_rows(path)
+        for col, tag in (
+            ("device_verifier_verifierOccupancy_avg", "occupancy"),
+            ("device_launch_launchTimeMs_avg", "launch ms"),
+            ("device_subgroup_g2SubgroupCheckTimeMs_avg", "subgroup ms"),
+        ):
+            xs, ys = _series(rows, "nodes", col)
+            if xs:
+                series.append((f"{label}: {tag}", xs, ys))
+    if not series:
+        raise ValueError("no batch-plane columns in the given CSVs")
+    return _plot_xy(series, "nodes", "batch plane (ratio / ms)", out, logx=True)
+
+
 KINDS = {
     "time": plot_time_vs_nodes,
     "network": plot_network_vs_nodes,
     "sigchecked": plot_sigs_checked,
     "failing": plot_failing,
+    "batchplane": plot_batch_plane,
 }
 
 

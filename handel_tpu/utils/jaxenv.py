@@ -31,10 +31,10 @@ def apply_platform_env(
     host platform (the 8-device CPU mesh used by tests and dryrun) — must be
     set before jax initializes its backends.
     """
-    plat = os.environ.get("HANDEL_TPU_PLATFORM", default or "")
-    if not plat:
-        return
-    os.environ["JAX_PLATFORMS"] = plat
+    # the virtual-device flag must be set even when the platform is left
+    # alone (e.g. a mesh_devices>1 run with no $HANDEL_TPU_PLATFORM): it
+    # only affects the HOST cpu platform, so it is harmless on TPU runs,
+    # and it must land before jax initializes its backends
     if force_host_device_count is not None:
         flags = os.environ.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in flags:
@@ -42,6 +42,10 @@ def apply_platform_env(
                 flags
                 + f" --xla_force_host_platform_device_count={force_host_device_count}"
             ).strip()
+    plat = os.environ.get("HANDEL_TPU_PLATFORM", default or "")
+    if not plat:
+        return
+    os.environ["JAX_PLATFORMS"] = plat
     import jax
 
     jax.config.update("jax_platforms", plat)

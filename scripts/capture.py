@@ -1,0 +1,72 @@
+"""Capture simulation results into a combined CSV under results/.
+
+Runs every `[[runs]]` entry of a simulation TOML on the localhost platform
+and merges the per-run stats rows (one per run) into one CSV — the shape
+of the reference's shipped result files (simul/plots/csv/*.csv, one row
+per run with run/nodes/threshold/failing + measure columns).
+
+Usage:
+    python scripts/capture.py out.csv config.toml [--platform localhost]
+
+The per-run work dirs land next to out.csv in a .work/ directory and are
+kept for debugging.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import csv
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from handel_tpu.sim.config import load_config  # noqa: E402
+from handel_tpu.sim.platform import run_simulation  # noqa: E402
+
+
+def merge_csvs(paths: list[str], out: str) -> int:
+    """Union-of-columns row merge, sorted column order (stats.go style)."""
+    rows: list[dict[str, str]] = []
+    cols: set[str] = set()
+    for p in paths:
+        with open(p, newline="") as f:
+            for row in csv.DictReader(f):
+                rows.append(row)
+                cols.update(row)
+    header = sorted(cols)
+    with open(out, "w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=header, restval="0")
+        w.writeheader()
+        for row in rows:
+            w.writerow(row)
+    return len(rows)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("out")
+    ap.add_argument("config")
+    ap.add_argument("--platform", default="localhost")
+    args = ap.parse_args()
+
+    cfg = load_config(args.config)
+    workdir = os.path.join(os.path.dirname(os.path.abspath(args.out)) or ".", ".work")
+    results = asyncio.run(run_simulation(cfg, workdir, platform=args.platform))
+    csvs = []
+    for i, r in enumerate(results):
+        status = "ok" if r.ok else "FAILED"
+        print(f"run {i}: {status} -> {r.csv_path}", flush=True)
+        if not r.ok:
+            for _, err in r.outputs:
+                sys.stderr.write(err.decode(errors="replace")[-2000:])
+            return 1
+        csvs.append(r.csv_path)
+    n = merge_csvs(csvs, args.out)
+    print(f"{args.out}: {n} rows")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -33,14 +33,48 @@ def test_gossip_aggregate_then_verify_real_crypto():
     assert len(results) == 4
 
 
-def test_mesh_gossip_completes():
-    """gossipsub-analog mesh baseline (simul/p2p/libp2p/node.go:55-434):
-    fixed-degree overlay still reaches threshold everywhere."""
-    import asyncio
+def test_gossipsub_completes():
+    """Real gossipsub semantics (simul/p2p/libp2p/node.go:55-434): setup
+    barrier, per-topic meshes, eager push — every node reaches threshold."""
+    from handel_tpu.baselines.gossipsub import run_gossipsub
 
-    from handel_tpu.baselines.gossipsub import run_mesh_gossip
-
-    finals = asyncio.run(run_mesh_gossip(12, threshold=7, degree=3))
+    finals = asyncio.run(run_gossipsub(12, threshold=7))
     assert len(finals) == 12
     for ms in finals.values():
         assert ms.bitset.cardinality() >= 7
+
+
+def test_gossipsub_mesh_maintenance_and_lazy_repair():
+    """GRAFT/PRUNE keep meshes inside [D_lo, D_hi] and IHAVE/IWANT repair
+    holes: with a tiny eager degree the lazy channel must still complete
+    the aggregation, and the control counters must show it happened."""
+    from handel_tpu.baselines.gossip import run_gossip
+    from handel_tpu.baselines.gossipsub import GossipSubAggregator
+
+    nodes_seen = []
+
+    class Spy(GossipSubAggregator):
+        def __init__(self, *a, **kw):
+            super().__init__(*a, **kw)
+            nodes_seen.append(self)
+
+    finals = asyncio.run(
+        run_gossip(
+            16,
+            threshold=12,
+            aggregator_cls=Spy,
+            degree=2,
+            degree_lo=2,
+            degree_hi=4,
+            degree_lazy=3,
+        )
+    )
+    assert all(ms.bitset.cardinality() >= 12 for ms in finals.values())
+    assert any(n.grafts_sent > 0 for n in nodes_seen)
+    assert any(n.ihave_sent > 0 for n in nodes_seen)
+    assert any(n.iwant_sent > 0 for n in nodes_seen)
+    for n in nodes_seen:
+        for members in n.mesh.values():
+            assert len(members) <= n.D_hi + n.D  # grafted-over cap, pre-prune
+    # the setup barrier completed everywhere before anyone published
+    assert all(n.setup_complete for n in nodes_seen)
