@@ -75,12 +75,11 @@ class Config:
     # shared async batch-verify service (parallel/batch_verifier.py); None
     # means verify through the scheme's own batch_verify
     verifier: Optional[Callable] = None
-    # device-mesh width for the verification plane: >1 routes the device
-    # scheme's kernels through the shard_map'd variants (registry-sharded
-    # G2 sum, candidate-sharded pairing check, parallel/sharding.py; sizes
-    # that don't divide the mesh are padded with masked lanes). Consumed at
-    # scheme construction (models/bn254_jax.py BN254Device, sim/node.py)
-    mesh_devices: int = 1
+    # NOTE: the device-mesh width for the verification plane is NOT a
+    # runtime Config field — it is fixed at scheme construction
+    # (BN254Device(mesh_devices=...), models/bn254_jax.py; the sim TOML's
+    # `mesh_devices` knob plumbs it through sim/node.py). Handel itself is
+    # mesh-agnostic: it only sees the scheme's batch_verify.
 
 
 def default_config(num_nodes: int) -> Config:

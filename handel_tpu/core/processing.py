@@ -185,35 +185,30 @@ class BatchProcessing:
             await self._verify_and_publish(batch)
 
     def _select_batch(self) -> list[IncomingSig]:
-        """Pop the best-scored candidates, re-scoring lazily.
+        """Pop the best-scored candidates, re-scoring lazily but EXACTLY.
 
         The reference's readTodos (processing.go:171-220) re-scores the WHOLE
         queue per pick — O(queue) Python per step melts at a 4000-node flood.
-        Here enqueue-time scores order the heap and only popped entries are
-        re-scored against the current store: a popped entry whose fresh score
-        fell below the next queued score is pushed back (once per step, which
-        bounds the loop) instead of stealing a batch slot. Store updates only
-        ever *lower* a pending score in the common path (levels complete,
-        bitsets get dominated), so the stale keys are upper bounds and the
-        order matches the reference's; the rare raise (a new individual sig
-        patches more holes than the enqueue-time score knew) costs only
-        ordering, never a lost verification.
+        Here enqueue-time scores order the heap; a popped entry is re-scored
+        against the current store and, if its score went stale, re-inserted
+        at the fresh score instead of taking a batch slot. The store is fixed
+        within one call, so a refreshed entry popped again matches its key
+        and is taken — every entry costs at most two pops per call, and the
+        selected batch is exactly the current top of the queue. Verification
+        ORDER therefore matches the reference's best-first semantics; skipping
+        the whole-queue rescan only delays the pruning of entries that are
+        not near the top (they die at their eventual pop). Order fidelity is
+        load-bearing: a stale-ordered variant of this loop verified ~4x more
+        signatures per node at N=2000 because each check contributed less.
         """
         batch: list[IncomingSig] = []
-        pushed_back: set[int] = set()
         while self._heap and len(batch) < self.batch_size:
             neg, seq, sp = heapq.heappop(self._heap)
             fresh = self.evaluator.evaluate(sp) if sp.ms is not None else 0
             if fresh <= 0:
                 self.sig_suppressed += 1
                 continue
-            if (
-                fresh < -neg
-                and seq not in pushed_back
-                and self._heap
-                and -self._heap[0][0] > fresh
-            ):
-                pushed_back.add(seq)
+            if fresh != -neg:
                 heapq.heappush(self._heap, (-fresh, seq, sp))
                 continue
             batch.append(sp)

@@ -131,7 +131,6 @@ async def run_node_process(args) -> int:
         else:
             hconf = run.handel.to_config(threshold, seed=nid)
             hconf.batch_size = cfg.batch_size
-            hconf.mesh_devices = cfg.mesh_devices
             if shared_service is not None:
                 hconf.verifier = shared_service.verify
             h = Handel(

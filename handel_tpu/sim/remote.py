@@ -108,12 +108,20 @@ class SSHConnector(HostConnector):
         super().__init__(spec, staging)
         self.target = spec.connect.split(":", 1)[1]
 
+    def _remote(self, remote_cmd: str) -> str:
+        """Local shell line running `remote_cmd` on the target: the remote
+        command (already internally quoted) is quoted ONCE as a whole —
+        hand-nesting quotes inside a single-quoted string breaks on any
+        path that itself needs quoting."""
+        q = shlex.quote
+        return f"{self.SSH} {q(self.target)} {q(remote_cmd)}"
+
     async def ship(self, tar_path: str) -> None:
         q = shlex.quote
+        remote = f"mkdir -p {q(self.staging)} && tar -xzf - -C {q(self.staging)}"
         await _check(
             await asyncio.create_subprocess_shell(
-                f"cat {q(tar_path)} | {self.SSH} {q(self.target)} "
-                f"'mkdir -p {q(self.staging)} && tar -xzf - -C {q(self.staging)}'"
+                f"cat {q(tar_path)} | {self._remote(remote)}"
             ),
             f"ssh ship to {self.target}",
         )
@@ -121,8 +129,7 @@ class SSHConnector(HostConnector):
     async def run(self, cmd: str) -> asyncio.subprocess.Process:
         q = shlex.quote
         return await asyncio.create_subprocess_shell(
-            f"{self.SSH} {q(self.target)} "
-            f"'cd {q(self.staging)} && {cmd}'",
+            self._remote(f"cd {q(self.staging)} && {cmd}"),
             stdout=asyncio.subprocess.PIPE,
             stderr=asyncio.subprocess.PIPE,
         )
@@ -130,7 +137,7 @@ class SSHConnector(HostConnector):
     async def kill_pattern(self, pattern: str) -> None:
         q = shlex.quote
         p = await asyncio.create_subprocess_shell(
-            f"{self.SSH} {q(self.target)} 'pkill -f {q(pattern)} 2>/dev/null; true'"
+            self._remote(f"pkill -f {q(pattern)} 2>/dev/null; true")
         )
         await p.wait()
 
@@ -202,7 +209,13 @@ class RemotePlatform:
         self.config_path = os.path.join(workdir, "sim.toml")
         with open(self.config_path, "w") as f:
             f.write(dump_config(cfg))
-        run_tag = os.path.basename(os.path.normpath(workdir)) or "run"
+        # default staging dirs carry the orchestrator pid: two concurrent
+        # runs with same-basename workdirs must not clobber each other's
+        # shipped package/registry
+        run_tag = (
+            f"{os.path.basename(os.path.normpath(workdir)) or 'run'}"
+            f"_{os.getpid()}"
+        )
         self.connectors = [
             _connector(
                 h,
