@@ -146,16 +146,26 @@ class GossipSubAggregator:
             self.mesh.setdefault(topic, set()).add(peer)
         elif kind == _PRUNE:
             self.mesh.get(topic, set()).discard(peer)
-        elif kind == _IHAVE:
-            missing = [t for t in _parse_topics(payload) if t not in self.sigs]
-            if missing:
-                self.iwant_sent += 1
-                self._send(peer, _frame(_IWANT, 0, _topics_payload(missing)))
-        elif kind == _IWANT:
-            for t in _parse_topics(payload):
-                sig = self.sigs.get(t)
-                if sig is not None:
-                    self._send(peer, _frame(_PUB, t, sig.marshal()))
+        elif kind in (_IHAVE, _IWANT):
+            # a truncated control payload must not raise out of the
+            # transport's listener callback — drop the frame, like the
+            # unmarshal_signature guard in _deliver
+            try:
+                topics = _parse_topics(payload)
+            except struct.error:
+                return
+            if kind == _IHAVE:
+                missing = [t for t in topics if t not in self.sigs]
+                if missing:
+                    self.iwant_sent += 1
+                    self._send(
+                        peer, _frame(_IWANT, 0, _topics_payload(missing))
+                    )
+            else:
+                for t in topics:
+                    sig = self.sigs.get(t)
+                    if sig is not None:
+                        self._send(peer, _frame(_PUB, t, sig.marshal()))
 
     def _deliver(self, topic: int, sig_bytes: bytes, from_peer: int) -> None:
         if topic in self.sigs or not (0 <= topic < self.reg.size()):

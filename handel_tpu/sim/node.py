@@ -223,6 +223,10 @@ def main() -> int:
     ap.add_argument("--monitor", default="")
     ap.add_argument("--run", type=int, default=0)
     ap.add_argument("--ids", required=True)
+    # run-scoping marker only: never read, but present in argv so the
+    # orchestrator's cleanup pkill can match THIS run's node processes
+    # without killing other simulations on a shared host (sim/remote.py)
+    ap.add_argument("--tag", default="")
     args = ap.parse_args()
     return asyncio.run(run_node_process(args))
 

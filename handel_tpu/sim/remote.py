@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import asyncio
 import os
+import re
 import shlex
 import sys
 import tarfile
@@ -234,6 +235,22 @@ class RemotePlatform:
         await asyncio.gather(*(c.ship(tar_path) for c in self.connectors))
         self._configured = True
 
+    async def _kill_everywhere(self, procs) -> None:
+        """Kill the local client processes AND this run's remote nodes.
+        Remote processes outlive their dead ssh client; the --tag (per-run
+        staging dir, regex-escaped for pkill -f) scopes the kill to THIS
+        run's nodes, not every simulation on a shared host."""
+        _kill_all(procs)
+        await asyncio.gather(
+            *(
+                c.kill_pattern(
+                    f"handel_tpu[.]sim[.]node.*--tag {re.escape(c.staging)}"
+                )
+                for c in self.connectors
+                if isinstance(c, SSHConnector)
+            )
+        )
+
     async def start_run(self, run_index: int):
         from handel_tpu.sim.platform import RunResult, free_ports
 
@@ -311,7 +328,8 @@ class RemotePlatform:
                         f"--config sim.toml --registry {registry_name} "
                         f"--master {cfg.master_ip}:{master_port} "
                         f"--monitor {cfg.master_ip}:{monitor_port} "
-                        f"--run {run_index} --ids {','.join(map(str, ids))}"
+                        f"--run {run_index} --ids {','.join(map(str, ids))} "
+                        f"--tag {shlex.quote(conn.staging)}"
                     )
                     env = "PYTHONPATH=. "
                     if os.environ.get("HANDEL_TPU_PLATFORM"):
@@ -329,16 +347,18 @@ class RemotePlatform:
                 await sync.wait_all(STATE_END, cfg.max_timeout_s)
             except asyncio.TimeoutError:
                 timed_out = True
-                _kill_all(procs)
-                # remote processes outlive their dead ssh client
-                await asyncio.gather(
-                    *(
-                        c.kill_pattern("handel_tpu.sim.node")
-                        for c in self.connectors
-                        if isinstance(c, SSHConnector)
-                    )
+                await self._kill_everywhere(procs)
+            try:
+                # grace period: a node can pass the END barrier yet fail to
+                # exit (stuck device teardown) — don't hang the run forever
+                outs = await asyncio.wait_for(
+                    asyncio.gather(*(p.communicate() for p in procs)),
+                    timeout=60.0,
                 )
-            outs = await asyncio.gather(*(p.communicate() for p in procs))
+            except asyncio.TimeoutError:
+                timed_out = True
+                await self._kill_everywhere(procs)
+                outs = [(b"", b"")] * len(procs)
             rcs = [p.returncode for p in procs]
         finally:
             _kill_all(procs)

@@ -78,3 +78,67 @@ def test_gossipsub_mesh_maintenance_and_lazy_repair():
             assert len(members) <= n.D_hi + n.D  # grafted-over cap, pre-prune
     # the setup barrier completed everywhere before anyone published
     assert all(n.setup_complete for n in nodes_seen)
+
+
+def test_gossipsub_malformed_control_frames_dropped():
+    """A truncated IHAVE/IWANT payload must be dropped, not raise
+    struct.error out of the transport's listener callback (ADVICE r3) —
+    mirroring the unmarshal_signature guard on PUB frames."""
+    import struct
+
+    from handel_tpu.baselines.gossipsub import (
+        _IHAVE,
+        _IWANT,
+        _PUB,
+        GOSSIPSUB_LEVEL,
+        GossipSubAggregator,
+    )
+    from handel_tpu.core.net import Packet
+
+    class NullNet:
+        def register_listener(self, l):
+            pass
+
+        def send(self, ids, pkt):
+            pass
+
+    from handel_tpu.core.identity import ArrayRegistry, Identity
+    from handel_tpu.models.fake import FakeConstructor, FakePublic, FakeSecret
+
+    reg = ArrayRegistry(
+        [Identity(i, f"x-{i}", FakePublic(True)) for i in range(4)]
+    )
+
+    async def go():
+        agg = GossipSubAggregator(
+            NullNet(),
+            reg,
+            reg.identity(0),
+            FakeConstructor(),
+            b"m",
+            FakeSecret(0).sign(b"m"),
+            3,
+        )
+        # 5-byte header + topic list declaring ONE entry but carrying only
+        # 3 of its 4 bytes — _parse_topics must hit struct.error inside
+        # the guard, not propagate it
+        truncated_list = struct.pack(">H", 1) + b"\x00\x00\x01"
+        for kind in (_IHAVE, _IWANT):
+            agg.new_packet(
+                Packet(
+                    origin=1,
+                    level=GOSSIPSUB_LEVEL,
+                    multisig=struct.pack(">BI", kind, 0) + truncated_list,
+                )
+            )
+        # truncated PUB payload for a topic NOT already delivered (the
+        # aggregator is node 0, whose own topic is pre-seeded) exercises
+        # the existing unmarshal guard in _deliver
+        agg.new_packet(
+            Packet(
+                origin=1, level=GOSSIPSUB_LEVEL, multisig=struct.pack(">BI", _PUB, 1)
+            )
+        )
+        return True
+
+    assert asyncio.run(go())

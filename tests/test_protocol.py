@@ -252,6 +252,72 @@ def test_heap_priority_and_lazy_suppression():
     assert suppressed >= 1  # the stale origin-2 entry died at dequeue
 
 
+def test_heap_rescore_after_score_raise():
+    """A queued candidate whose score RISES after a verified publish (e.g.
+    jumping into the store's level-completion bracket as indiv_verified
+    grows) must be selected before lower-scored entries: the publish marks
+    the heap dirty and the next selection rebuilds it with fresh scores.
+    Pop-refresh alone would leave the risen entry buried at its stale-low
+    key (ADVICE r3)."""
+    from handel_tpu.core.bitset import BitSet
+    from handel_tpu.core.crypto import MultiSignature
+    from handel_tpu.core.identity import ArrayRegistry, Identity
+    from handel_tpu.core.partitioner import BinomialPartitioner, IncomingSig
+    from handel_tpu.core.processing import BatchProcessing
+    from handel_tpu.models.fake import FakePublic, FakeSignature
+
+    async def go():
+        reg = ArrayRegistry(
+            [Identity(i, f"x-{i}", FakePublic(True)) for i in range(8)]
+        )
+        part = BinomialPartitioner(0, reg)
+        # A verified first; B buried below C until A's publish raises it
+        scores = {1: 10, 2: 3, 3: 4}
+        verified_order = []
+
+        class Eval:
+            def evaluate(self, sp):
+                return scores[sp.origin]
+
+        def on_verified(sp):
+            verified_order.append(sp.origin)
+            if sp.origin == 1:
+                scores[2] = 9  # the store-mutation score raise
+
+        async def ok(msg, pubkeys, requests):
+            return [True] * len(requests)
+
+        proc = BatchProcessing(
+            part,
+            FakeConstructor(),
+            b"m",
+            [None] * 8,
+            Eval(),
+            on_verified,
+            batch_size=1,
+            verifier=ok,
+        )
+        proc.start()
+        for origin in (1, 2, 3):
+            bs = BitSet(1)
+            bs.set(0)
+            proc.add(
+                IncomingSig(
+                    origin=origin,
+                    level=1,
+                    ms=MultiSignature(bs, FakeSignature()),
+                )
+            )
+        for _ in range(80):
+            await asyncio.sleep(0.01)
+            if len(verified_order) >= 3:
+                break
+        proc.stop()
+        return verified_order
+
+    assert run(go()) == [1, 2, 3]  # risen B (9) beats C (4) after rebuild
+
+
 def test_fifo_processing_cluster():
     """The deprecated arrival-order pipeline (processing.go:380-493) still
     completes aggregation — the A/B counterpart to the evaluator strategy."""
