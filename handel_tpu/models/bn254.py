@@ -5,8 +5,8 @@ points (X = x*B2), signatures are G1 points (S = x*H(m)), verification checks
 e(H(m), X) == e(S, B2), aggregation is plain point addition, and hash-to-G1
 derives a scalar from SHA256(msg) and multiplies the G1 base point
 (bn256/go/bn256.go:206-218 — the reference's known-scalar construction,
-mirrored here: k = SHA256(msg) mod r, H(m) = k*G1; same caveat as the
-reference's issue #122).
+whose exact derivation algorithm is mirrored in `hash_to_g1` below; same
+caveat as the reference's issue #122).
 
 Wire formats (64-byte G1 = x||y big-endian, 128-byte G2 with imaginary
 coefficient first, zero bytes = point at infinity) mirror cloudflare/bn256's
@@ -97,12 +97,32 @@ def unmarshal_g2(data: bytes, check_subgroup: bool = True):
 
 
 def hash_to_g1(msg: bytes):
-    """H(m) = (SHA256(m) mod r) * G1 — the reference's derivation
-    (bn256/go/bn256.go:206-218)."""
-    k = int.from_bytes(hashlib.sha256(msg).digest(), "big") % bn.R
-    if k == 0:
-        k = 1
-    return nat.g1_mul(bn.G1_GEN, k)
+    """H(m) = k*G1, with k derived by the reference's exact algorithm.
+
+    The reference (bn256/go/bn256.go:206-218) feeds SHA256(msg) into a
+    bytes.Buffer seeding x/crypto/bn256.RandomG1, i.e. Go crypto/rand.Int
+    over the group order: read ceil(BitLen(order)/8) = 32 bytes, mask the
+    TOP byte down to BitLen(order) % 8 bits (keep all 8 when that is 0),
+    interpret big-endian, and retry on a draw >= order — which on the
+    one-shot 32-byte buffer hits EOF, so the reference ERRORS for ~44% of
+    possible digests (the known flaw its comment flags as issue #122).
+
+    Mirrored here over OUR order (alt_bn128 r, bit length 254, so the top
+    byte keeps 254 % 8 = 6 bits); where the reference would error we
+    deterministically re-hash the digest instead, keeping every message
+    signable. Note the reference rides golang.org/x/crypto/bn256's 256-bit
+    BN curve — a different curve than alt_bn128 — so signatures were never
+    byte-cross-verifiable; the mirror is of the scalar derivation, not the
+    wire bytes.
+    """
+    keep = bn.R.bit_length() % 8  # Go rand.Int's top-byte mask width
+    mask = (1 << keep) - 1 if keep else 0xFF
+    digest = hashlib.sha256(msg).digest()
+    while True:
+        k = int.from_bytes(bytes([digest[0] & mask]) + digest[1:], "big")
+        if 0 < k < bn.R:
+            return nat.g1_mul(bn.G1_GEN, k)
+        digest = hashlib.sha256(digest).digest()  # reference EOF-errors here
 
 
 class BN254Signature:

@@ -103,6 +103,21 @@ def scenario_timeout(nodes: int = 2000) -> SimConfig:
     )
 
 
+def scenario_update_count(nodes: int = 2000) -> SimConfig:
+    """Per-tick update fanout sweep: how many peers each node refreshes per
+    period (confgenerator.go:135-162 updateCountScenario, updates 1/10/20
+    at N=2000)."""
+    return SimConfig(
+        network="udp",
+        scheme="bn254-jax",
+        runs=[
+            r
+            for uc in (1, 10, 20)
+            for r in _runs([nodes], lambda n: n * 99 // 100, update_count=uc)
+        ],
+    )
+
+
 def scenario_nsquare() -> SimConfig:
     """Full-diffusion gossip baseline matrix (nsquare scenario)."""
     return SimConfig(
@@ -161,6 +176,7 @@ SCENARIOS = {
     "failing": scenario_failing,
     "period": scenario_period,
     "timeout": scenario_timeout,
+    "update_count": scenario_update_count,
     "nsquare": scenario_nsquare,
     "gossipsub": scenario_gossipsub,
     "practical": scenario_practical,

@@ -72,6 +72,20 @@ class RunConfig:
 
         return percentage_to_contributions(DEFAULT_CONTRIBUTIONS_PERC, self.nodes)
 
+    def stats_extra(self, run_index: int) -> dict[str, float]:
+        """Per-run identity + swept protocol knobs for the stats CSV, so
+        parameter-sweep captures are self-describing (the reference embeds
+        the lib.Config fields the same way). Shared by both platforms."""
+        return {
+            "run": float(run_index),
+            "nodes": float(self.nodes),
+            "threshold": float(self.resolved_threshold()),
+            "failing": float(self.failing),
+            "period_ms": float(self.handel.period_ms),
+            "timeout_ms": float(self.handel.timeout_ms),
+            "update_count": float(self.handel.update_count),
+        }
+
 
 @dataclass
 class HostSpec:

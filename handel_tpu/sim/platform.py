@@ -199,12 +199,7 @@ class LocalhostPlatform:
             monitor.stop()
 
         # stats CSV (localhost.go:201-206)
-        monitor.stats.extra = {
-            "run": float(run_index),
-            "nodes": float(run.nodes),
-            "threshold": float(run.resolved_threshold()),
-            "failing": float(run.failing),
-        }
+        monitor.stats.extra = run.stats_extra(run_index)
         csv_path = os.path.join(self.dir, f"results_{run_index}.csv")
         monitor.stats.write_csv(csv_path)
         ok = (

@@ -14,6 +14,7 @@ produced by `Stats.write_csv` (sim/monitor.py) and writes a PNG. CLI:
 from __future__ import annotations
 
 import csv
+import functools
 
 
 def read_rows(path: str) -> list[dict[str, float]]:
@@ -92,6 +93,54 @@ def plot_failing(csvs: dict[str, str], out: str):
     return _plot_xy(series, "failing nodes", "aggregation time (s)", out)
 
 
+def plot_sweep(csvs: dict[str, str], out: str, *, xcol: str = "period_ms"):
+    """Protocol-knob sweep: completion time (left axis) and signatures
+    checked per node (right axis) vs the swept parameter (`period_ms` |
+    `timeout_ms` | `update_count`, columns the platforms embed per run) —
+    the periodInc/timeoutInc/updateCount figures of
+    simul/confgenerator/confgenerator.go. Twin axes because the two
+    metrics live on different scales (~1 s vs ~60 sigs)."""
+    import sys
+
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, ax_t = plt.subplots(figsize=(7, 4.5))
+    ax_s = ax_t.twinx()
+    plotted = False
+    for label, path in csvs.items():
+        rows = read_rows(path)
+        xs, ys = _series(rows, xcol, "sigen_wall_avg")
+        if not xs:
+            # pre-knob-column captures would silently vanish from a
+            # comparison figure otherwise
+            print(
+                f"plot_sweep: '{label}' has no '{xcol}' column, skipped",
+                file=sys.stderr,
+            )
+            continue
+        ax_t.plot(xs, ys, marker="o", label=f"{label}: time (s)")
+        xs, ys = _series(rows, xcol, "sigs_sigCheckedCt_avg")
+        ax_s.plot(
+            xs, ys, marker="s", linestyle="--", label=f"{label}: sigs checked"
+        )
+        plotted = True
+    if not plotted:
+        raise ValueError(f"no '{xcol}' sweep columns in the given CSVs")
+    ax_t.set_xlabel(xcol)
+    ax_t.set_ylabel("aggregation time (s)")
+    ax_s.set_ylabel("signatures checked / node")
+    ax_t.grid(True, alpha=0.3)
+    h1, l1 = ax_t.get_legend_handles_labels()
+    h2, l2 = ax_s.get_legend_handles_labels()
+    ax_t.legend(h1 + h2, l1 + l2, fontsize=8)
+    fig.tight_layout()
+    fig.savefig(out, dpi=120)
+    return out
+
+
 def plot_batch_plane(csvs: dict[str, str], out: str):
     """Batch-plane telemetry vs committee size: shared-launch occupancy,
     device wall time per launch, and host G2 subgroup-check time — the
@@ -119,6 +168,9 @@ KINDS = {
     "sigchecked": plot_sigs_checked,
     "failing": plot_failing,
     "batchplane": plot_batch_plane,
+    "period": functools.partial(plot_sweep, xcol="period_ms"),
+    "timeout": functools.partial(plot_sweep, xcol="timeout_ms"),
+    "updatecount": functools.partial(plot_sweep, xcol="update_count"),
 }
 
 

@@ -365,12 +365,7 @@ class RemotePlatform:
             sync.stop()
             monitor.stop()
 
-        monitor.stats.extra = {
-            "run": float(run_index),
-            "nodes": float(run.nodes),
-            "threshold": float(run.resolved_threshold()),
-            "failing": float(run.failing),
-        }
+        monitor.stats.extra = run.stats_extra(run_index)
         csv_path = os.path.join(self.dir, f"results_{run_index}.csv")
         monitor.stats.write_csv(csv_path)
         ok = (

@@ -71,6 +71,51 @@ def test_hash_to_g1_deterministic():
     assert hash_to_g1(b"x") != hash_to_g1(b"y")
 
 
+def test_hash_to_g1_mirrors_go_rand_int_derivation():
+    """The H(m) scalar must follow Go crypto/rand.Int semantics exactly as
+    the reference's SHA256->bytes.Buffer->RandomG1 chain does
+    (bn256/go/bn256.go:206-218): 32 bytes big-endian with the top byte
+    masked to order.bit_length() % 8 bits — NOT a mod-r reduction — and a
+    deterministic re-hash standing in for the reference's EOF error on a
+    draw >= r. Expected scalars here are computed by an independent
+    re-statement of that algorithm."""
+    import hashlib
+
+    from handel_tpu.ops import bn254_ref as bn
+
+    def go_rand_int_scalar(msg: bytes) -> int:
+        d = hashlib.sha256(msg).digest()
+        while True:
+            v = int.from_bytes(d, "big")
+            v &= (1 << 254) - 1  # r.bit_length()=254: top byte keeps 6 bits
+            if 0 < v < bn.R:
+                return v
+            d = hashlib.sha256(d).digest()  # our stand-in for the EOF error
+
+    # masking case: a digest whose top byte exceeds 0x3f must be masked,
+    # not reduced mod r (mod-r of the unmasked value gives a different k)
+    masked_msg = rehash_msg = None
+    for i in range(4096):
+        m = b"probe-%d" % i
+        d = hashlib.sha256(m).digest()
+        masked = int.from_bytes(d, "big") & ((1 << 254) - 1)
+        if masked_msg is None and d[0] > 0x3F and masked < bn.R:
+            if masked != int.from_bytes(d, "big") % bn.R:
+                masked_msg = m
+        if rehash_msg is None and masked >= bn.R:
+            rehash_msg = m
+        if masked_msg and rehash_msg:
+            break
+    assert masked_msg and rehash_msg, "probe space too small"
+
+    for msg in (masked_msg, rehash_msg, MSG):
+        expected = bn.g1_mul(bn.G1_GEN, go_rand_int_scalar(msg))
+        assert hash_to_g1(msg) == expected
+    # the re-hash path still yields a signable point
+    sk, pk = new_keypair(seed=7)
+    assert pk.verify(rehash_msg, sk.sign(rehash_msg))
+
+
 def test_batch_verify_via_constructor():
     cons = BN254Constructor()
     keys = [new_keypair(seed=i) for i in range(4)]

@@ -53,6 +53,21 @@ def test_plots_render_png(tmp_path):
         plots.KINDS[kind]({"handel": csv_path}, out)
         assert os.path.getsize(out) > 1000
 
+    # knob-sweep kinds read the per-run parameter columns the platforms
+    # embed (confgenerator.go periodInc/timeoutInc/updateCount figures)
+    sweep_rows = []
+    for period, wall in [(10.0, 0.9), (50.0, 0.6), (100.0, 0.8)]:
+        st = Stats(extra={"nodes": 2000, "period_ms": period})
+        st.update("sigen_wall", wall)
+        st.update("sigs_sigCheckedCt", 60)
+        sweep_rows.append(st)
+    sweep_csv = str(tmp_path / "period.csv")
+    for i, st in enumerate(sweep_rows):
+        st.write_csv(sweep_csv, append=i > 0)
+    out = str(tmp_path / "period.png")
+    plots.KINDS["period"]({"handel": sweep_csv}, out)
+    assert os.path.getsize(out) > 1000
+
 
 def test_report_aggregator_prefixes():
     class R:

@@ -131,3 +131,49 @@ def test_combine_empty():
     p = part(8, 1)
     assert p.combine([], 2) is None
     assert p.combine_full([]) is None
+
+
+def test_depth_14_committee_structure():
+    """16k committee = the depth-14 binomial tree (BASELINE.json configs[4]).
+    Structural invariants of partitioner.go:133-178 at scale: each level l
+    of a power-of-two committee spans 2^(l-1) ids, the levels partition
+    everything except the node itself, and level ranges are symmetric
+    (j in id's level-l range <=> id in j's level-l range) — the property
+    the protocol relies on so level-l packets land on peers that place the
+    sender at the same level."""
+    n = 16384
+    for nid in (0, 1, 5000, 12345, n - 1):
+        p = part(n, nid)
+        assert p.levels() == list(range(1, 15))
+        seen = set()
+        for level in range(1, 15):
+            lo, hi = p.range_level(level)
+            assert hi - lo == 1 << (level - 1)
+            assert p.size_of(level) == hi - lo
+            rng = set(range(lo, hi))
+            assert nid not in rng
+            assert not (seen & rng)
+            seen |= rng
+        assert len(seen) == n - 1
+
+    # symmetry probe across a few (id, peer) pairs at the deep levels
+    for nid, level in ((0, 14), (12345, 14), (5000, 13)):
+        p = part(n, nid)
+        lo, hi = p.range_level(level)
+        for peer in (lo, (lo + hi) // 2, hi - 1):
+            q = part(n, peer)
+            qlo, qhi = q.range_level(level)
+            assert qlo <= nid < qhi
+
+    # non-power-of-two at the same depth: truncated-but-covering partition
+    # (rangeLevel clamps max to size, empty levels are skipped)
+    n2 = 16000
+    p = part(n2, n2 - 1)
+    seen = set()
+    for level in p.levels():
+        lo, hi = p.range_level(level)
+        assert hi <= n2
+        rng = set(range(lo, hi))
+        assert not (seen & rng)
+        seen |= rng
+    assert len(seen) == n2 - 1
