@@ -96,6 +96,11 @@ class HostSpec:
     ip: str = "127.0.0.1"  # address other nodes dial this host's nodes at
     python: str = ""  # remote python executable ("" = this interpreter)
     workdir: str = ""  # staging dir on the host ("" = per-host tmp dir)
+    # this host holds the accelerator: with shared_verifier + a device
+    # scheme, one process here serves the batch plane over TCP and every
+    # chip-less process in the fleet verifies through it
+    # (parallel/rpc_verifier.py)
+    device: bool = False
 
 
 @dataclass
@@ -147,6 +152,7 @@ def load_config(path: str) -> SimConfig:
                 ip=str(h.get("ip", "127.0.0.1")),
                 python=str(h.get("python", "")),
                 workdir=str(h.get("workdir", "")),
+                device=bool(h.get("device", False)),
             )
         )
     for r in raw.get("runs", []):
@@ -197,6 +203,7 @@ def dump_config(cfg: SimConfig) -> str:
             f'ip = "{h.ip}"',
             f'python = "{h.python}"',
             f'workdir = "{h.workdir}"',
+            f"device = {str(h.device).lower()}",
         ]
     for r in cfg.runs:
         lines += [

@@ -76,7 +76,18 @@ async def run_node_process(args) -> int:
     # one transport per logical node, bound to its registry address
     nets, handels = [], []
     shared_service = None
-    if (
+    rpc_client = None
+    rpc_server = None
+    if args.verifier and not cfg.baseline:
+        # chip-less process: ship candidate batches to the fleet's device
+        # host instead of preparing a local device (no kernels compiled
+        # here at all — parallel/rpc_verifier.py)
+        from handel_tpu.parallel.rpc_verifier import RPCVerifier
+
+        rpc_client = RPCVerifier(args.verifier)
+        if plane is not None:
+            plane.add("rpc", rpc_client)
+    elif (
         cfg.shared_verifier
         and hasattr(scheme.constructor, "Device")
         and not cfg.baseline
@@ -96,6 +107,20 @@ async def run_node_process(args) -> int:
         if plane is not None:
             plane.add("verifier", shared_service)
             plane.add("launch", launch_timer)
+        if args.serve_verifier:
+            # this is the fleet's device host: serve the batch plane to
+            # every chip-less process BEFORE the START barrier, so remote
+            # clients never race the bind
+            from handel_tpu.parallel.rpc_verifier import VerifierServer
+
+            rpc_server = VerifierServer(
+                shared_service,
+                scheme.constructor,
+                port=args.serve_verifier,
+            )
+            await rpc_server.start()
+            if plane is not None:
+                plane.add("rpcserve", rpc_server)
 
     for nid in ids:
         rec = records[nid]
@@ -133,6 +158,8 @@ async def run_node_process(args) -> int:
             hconf.batch_size = cfg.batch_size
             if shared_service is not None:
                 hconf.verifier = shared_service.verify
+            elif rpc_client is not None:
+                hconf.verifier = rpc_client.verify
             h = Handel(
                 net,
                 registry,
@@ -155,13 +182,11 @@ async def run_node_process(args) -> int:
     )
 
     measures = []
-    for idx, (nid, h, net) in enumerate(handels):
+    for nid, h, net in handels:
         if sink:
             sig_counters = h.proc if hasattr(h, "proc") else h  # gossip: self
             ms = [TimeMeasure(sink, "sigen"), CounterIO(sink, "net", net),
                   CounterIO(sink, "sigs", sig_counters)]
-            if idx == 0 and device_meas is not None:
-                ms.append(device_meas)  # batch plane: once per process
             measures.append(tuple(ms))
         else:
             measures.append(None)
@@ -206,8 +231,20 @@ async def run_node_process(args) -> int:
     await asyncio.gather(
         *(s.signal_and_wait(STATE_END, cfg.max_timeout_s) for s in slaves)
     )
+    # batch-plane record (once per process) AFTER the fleet-wide END
+    # barrier: a verifier-serving process keeps answering other hosts'
+    # RPC batches until every node everywhere is done, so recording at
+    # local-node completion would freeze its served counters early. The
+    # master's monitor stays up until it has collected process exits, so
+    # this post-barrier record still lands.
+    if device_meas is not None:
+        device_meas.record()
     for s in slaves:
         s.stop()
+    if rpc_client is not None:
+        rpc_client.stop()
+    if rpc_server is not None:
+        rpc_server.stop()
     if sink:
         sink.close()
     if ok:
@@ -227,6 +264,10 @@ def main() -> int:
     # orchestrator's cleanup pkill can match THIS run's node processes
     # without killing other simulations on a shared host (sim/remote.py)
     ap.add_argument("--tag", default="")
+    # batch-plane RPC (parallel/rpc_verifier.py): serve the local shared
+    # verifier on this port / verify through the fleet's device host
+    ap.add_argument("--serve-verifier", type=int, default=0)
+    ap.add_argument("--verifier", default="")
     args = ap.parse_args()
     return asyncio.run(run_node_process(args))
 

@@ -301,6 +301,34 @@ class RemotePlatform:
             master_port, monitor_port = cfg.base_port - 2, cfg.base_port - 1
         else:
             master_port, monitor_port = free_ports(2)
+
+        # batch-plane RPC (parallel/rpc_verifier.py): with a device-flagged
+        # host and the shared verifier on a device scheme, exactly one
+        # process on that host serves every other process's verification.
+        # The port is probed on the orchestrator; a real fleet sets
+        # base_port, whose -3 slot is reserved for the verifier
+        verifier_host_idx = next(
+            (i for i, h in enumerate(hosts) if h.device), None
+        )
+        serve_verifier = (
+            cfg.shared_verifier
+            and is_device_scheme(cfg.scheme)
+            and verifier_host_idx is not None
+            and not cfg.baseline  # baseline runs never touch the verifier
+        )
+        verifier_port = (
+            (cfg.base_port - 3 if cfg.base_port else free_ports(1)[0])
+            if serve_verifier
+            else 0
+        )
+        if serve_verifier and not any(
+            alloc[nid].active and alloc[nid].instance == verifier_host_idx
+            for nid in alloc
+        ):
+            raise ValueError(
+                "device host has no active node process to serve the "
+                "verifier from (all its nodes are failing)"
+            )
         by_host_proc: dict[int, dict[int, list[int]]] = {}
         for nid, slot in alloc.items():
             if slot.active:
@@ -320,6 +348,7 @@ class RemotePlatform:
         procs: list[asyncio.subprocess.Process] = []
         timed_out = False
         try:
+            served = False
             for hidx, by_proc in sorted(by_host_proc.items()):
                 conn = self.connectors[hidx]
                 py = hosts[hidx].python or sys.executable
@@ -331,6 +360,15 @@ class RemotePlatform:
                         f"--run {run_index} --ids {','.join(map(str, ids))} "
                         f"--tag {shlex.quote(conn.staging)}"
                     )
+                    if serve_verifier:
+                        if hidx == verifier_host_idx and not served:
+                            flags += f" --serve-verifier {verifier_port}"
+                            served = True
+                        else:
+                            flags += (
+                                " --verifier "
+                                f"{hosts[verifier_host_idx].ip}:{verifier_port}"
+                            )
                     env = "PYTHONPATH=. "
                     if os.environ.get("HANDEL_TPU_PLATFORM"):
                         env += (

@@ -196,6 +196,56 @@ def test_remote_platform_two_hosts(tmp_path):
     assert "sigen_wall_avg" in header
 
 
+@pytest.mark.slow
+def test_remote_platform_rpc_verifier(tmp_path, monkeypatch):
+    """The batch-plane RPC (parallel/rpc_verifier.py): host A is flagged
+    `device = true`, so its node process serves the shared
+    BatchVerifierService over TCP and host B's chip-less process verifies
+    every candidate through it — the fleet topology where one accelerator
+    host serves all others (BASELINE.json north_star). Asserts the run
+    completes AND that host B actually shipped candidates over the link
+    (rpc counters on the monitor plane)."""
+    from handel_tpu.sim.config import HostSpec
+    from handel_tpu.sim.platform import run_simulation
+
+    monkeypatch.setenv("HANDEL_TPU_PLATFORM", "cpu")
+    cfg = SimConfig(
+        network="udp",
+        scheme="bn254-jax",
+        batch_size=8,
+        shared_verifier=True,
+        max_timeout_s=900.0,
+        hosts=[
+            HostSpec(
+                connect="local", workdir=str(tmp_path / "hostA"), device=True
+            ),
+            HostSpec(connect="local", workdir=str(tmp_path / "hostB")),
+        ],
+        runs=[
+            RunConfig(
+                nodes=8,
+                threshold=5,
+                processes=1,
+                handel=HandelParams(period_ms=50.0, timeout_ms=200.0),
+            )
+        ],
+    )
+    results = asyncio.run(
+        run_simulation(cfg, str(tmp_path / "out"), platform="remote")
+    )
+    res = results[0]
+    if not res.ok:
+        for out, err in res.outputs:
+            print(out.decode(errors="replace"))
+            print(err.decode(errors="replace"))
+    assert res.ok
+    rows = list(csv.DictReader(open(res.csv_path)))
+    # host B's process sent candidates over the link; host A served them
+    assert float(rows[0]["device_rpc_rpcSentCandidates_sum"]) > 0
+    assert float(rows[0]["device_rpcserve_rpcServedCandidates_sum"]) > 0
+    assert float(rows[0]["device_rpc_rpcLinkErrors_sum"]) == 0
+
+
 def test_localhost_platform_bn254_real_crypto(tmp_path):
     """Small run with real BN254 host crypto end-to-end over real sockets."""
     cfg = SimConfig(
