@@ -100,6 +100,45 @@ def free_ports(n: int) -> list[int]:
     return ports
 
 
+def port_plan(cfg, nodes: int) -> tuple[list[int], int, int, int]:
+    """The fleet's port layout, shared by both platforms: node i at
+    base_port + i, master at -2, monitor at -1, verifier RPC at -3.
+    With base_port unset, ports are probed (free_ports holds-and-releases
+    to guarantee availability; the verifier slot returns 0 — the caller
+    probes one on demand). Returns (node_ports, master, monitor, verifier).
+    """
+    base = cfg.base_port
+    if not base:
+        ports = free_ports(nodes + 2)
+        return ports[:nodes], ports[nodes], ports[nodes + 1], 0
+    if base < 4 or base + nodes > 65536:
+        raise ValueError(
+            f"base_port {base} with {nodes} nodes leaves no room for the "
+            f"master/monitor/verifier slots (need 4 <= base_port and "
+            f"base_port + nodes <= 65536)"
+        )
+    return [base + i for i in range(nodes)], base - 2, base - 1, base - 3
+
+
+def preflight_ports(ports: list[int]) -> None:
+    """Fail fast if any fixed-plan port is already taken on this host:
+    a silent bind failure inside one node process otherwise surfaces only
+    as a full max_timeout_s barrier stall. Binds and immediately closes
+    (sequential, so no fd accumulation at 16k ports)."""
+    for p in ports:
+        for fam in (socket.SOCK_DGRAM, socket.SOCK_STREAM):
+            s = socket.socket(socket.AF_INET, fam)
+            try:
+                s.bind(("127.0.0.1", p))
+            except OSError as e:
+                raise OSError(
+                    f"fixed port {p} is already in use ({e}); pick a "
+                    f"different base_port"
+                ) from e
+            finally:
+                s.close()
+
+
 class LocalhostPlatform:
     """Spawn every node process on this machine (localhost.go:16-266)."""
 
@@ -122,11 +161,17 @@ class LocalhostPlatform:
             apply_platform_env()
         scheme = new_scheme(cfg.scheme)
 
-        # ports: node addresses + master + monitor
-        ports = free_ports(run.nodes + 2)
-        addresses = [f"127.0.0.1:{p}" for p in ports[: run.nodes]]
-        master_addr = f"127.0.0.1:{ports[run.nodes]}"
-        monitor_port = cfg.monitor_port or ports[run.nodes + 1]
+        # ports: node addresses + master + monitor. With base_port set the
+        # fixed plan applies (probing holds 2 fds per port simultaneously,
+        # which blows the fd limit at committee sizes like 16384) — with a
+        # fail-fast probe of the range, since a taken port would otherwise
+        # surface only as a barrier stall after max_timeout_s
+        node_ports, master_p, monitor_p, _ = port_plan(cfg, run.nodes)
+        if cfg.base_port:
+            preflight_ports(node_ports + [master_p, monitor_p])
+        addresses = [f"127.0.0.1:{p}" for p in node_ports]
+        master_addr = f"127.0.0.1:{master_p}"
+        monitor_port = cfg.monitor_port or monitor_p
 
         # keygen -> registry CSV (localhost.go:79-92)
         records = simkeys.generate_nodes(scheme, addresses)

@@ -252,7 +252,7 @@ class RemotePlatform:
         )
 
     async def start_run(self, run_index: int):
-        from handel_tpu.sim.platform import RunResult, free_ports
+        from handel_tpu.sim.platform import RunResult, free_ports, port_plan
 
         if not self._configured:
             await self.configure()
@@ -273,13 +273,13 @@ class RemotePlatform:
 
         # addresses: every node advertised at its host's routable ip. With
         # base_port=0 (single-machine CI) ports are probed locally; a real
-        # fleet sets base_port and each node uses base_port + id
-        if cfg.base_port:
-            ports = [cfg.base_port + nid for nid in range(run.nodes)]
-        else:
-            if any(h.connect != "local" for h in hosts):
-                raise ValueError("base_port required with non-local hosts")
-            ports = free_ports(run.nodes)
+        # fleet sets base_port and the shared fixed plan applies
+        # (platform.py port_plan: node i at base_port + i)
+        if not cfg.base_port and any(h.connect != "local" for h in hosts):
+            raise ValueError("base_port required with non-local hosts")
+        ports, master_port, monitor_port, verifier_slot = port_plan(
+            cfg, run.nodes
+        )
         addresses = [
             f"{hosts[alloc[nid].instance].ip}:{ports[nid]}"
             for nid in range(run.nodes)
@@ -296,17 +296,11 @@ class RemotePlatform:
             tf.add(self.config_path, arcname="sim.toml")
         await asyncio.gather(*(c.ship(ship_tar) for c in self.connectors))
 
-        # master services bound for off-host reachability
-        if cfg.base_port:
-            master_port, monitor_port = cfg.base_port - 2, cfg.base_port - 1
-        else:
-            master_port, monitor_port = free_ports(2)
-
         # batch-plane RPC (parallel/rpc_verifier.py): with a device-flagged
         # host and the shared verifier on a device scheme, exactly one
         # process on that host serves every other process's verification.
-        # The port is probed on the orchestrator; a real fleet sets
-        # base_port, whose -3 slot is reserved for the verifier
+        # A fixed fleet uses the plan's base_port - 3 slot; otherwise the
+        # port is probed on the orchestrator
         verifier_host_idx = next(
             (i for i, h in enumerate(hosts) if h.device), None
         )
@@ -317,9 +311,7 @@ class RemotePlatform:
             and not cfg.baseline  # baseline runs never touch the verifier
         )
         verifier_port = (
-            (cfg.base_port - 3 if cfg.base_port else free_ports(1)[0])
-            if serve_verifier
-            else 0
+            (verifier_slot or free_ports(1)[0]) if serve_verifier else 0
         )
         if serve_verifier and not any(
             alloc[nid].active and alloc[nid].instance == verifier_host_idx

@@ -246,6 +246,72 @@ def test_remote_platform_rpc_verifier(tmp_path, monkeypatch):
     assert float(rows[0]["device_rpc_rpcLinkErrors_sum"]) == 0
 
 
+def test_localhost_platform_base_port(tmp_path):
+    """With base_port set the localhost platform assigns node i the fixed
+    port base_port + i instead of probing — probing holds two fds per
+    port simultaneously, which trips the fd limit at committee sizes like
+    16384 (the 16k capture's failure mode)."""
+    import csv as _csv
+
+    from handel_tpu.sim.platform import run_simulation
+
+    base = 13500  # below the 16000+ fixed ranges used by capture TOMLs
+    cfg = SimConfig(
+        network="udp",
+        scheme="fake",
+        base_port=base,
+        max_timeout_s=60.0,
+        runs=[RunConfig(nodes=8, threshold=5, processes=2)],
+    )
+    results = asyncio.run(run_simulation(cfg, str(tmp_path)))
+    assert results[0].ok
+    with open(str(tmp_path / "registry_0.csv")) as f:
+        rows = list(_csv.reader(f))
+    assert [r[1] for r in rows] == [
+        f"127.0.0.1:{base + i}" for i in range(8)
+    ]
+
+
+def test_port_plan_validates_bounds():
+    """A base_port without room for the reserved -2/-3 slots or whose
+    range runs past 65535 must fail immediately, not as a barrier stall
+    after max_timeout_s (port 0/negative/out-of-range binds misbehave
+    deep inside node processes)."""
+    import pytest
+
+    from handel_tpu.sim.platform import port_plan
+
+    with pytest.raises(ValueError):
+        port_plan(SimConfig(base_port=2), 8)
+    with pytest.raises(ValueError):
+        port_plan(SimConfig(base_port=65530), 8)
+    node_ports, master, monitor, verifier = port_plan(
+        SimConfig(base_port=18000), 8
+    )
+    assert node_ports == list(range(18000, 18008))
+    assert (master, monitor, verifier) == (17998, 17999, 17997)
+
+
+def test_preflight_ports_detects_conflict():
+    """The fixed-plan pre-flight fails fast with the conflicting port
+    named when something already holds one."""
+    import socket
+
+    import pytest
+
+    from handel_tpu.sim.platform import free_ports, preflight_ports
+
+    port = free_ports(1)[0]
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.bind(("127.0.0.1", port))
+    try:
+        with pytest.raises(OSError, match=str(port)):
+            preflight_ports([port])
+    finally:
+        s.close()
+    preflight_ports([port])  # released: now clean
+
+
 def test_localhost_platform_bn254_real_crypto(tmp_path):
     """Small run with real BN254 host crypto end-to-end over real sockets."""
     cfg = SimConfig(
