@@ -33,8 +33,14 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
-ARTIFACT = os.path.join(REPO, "results", "bench_tpu.json")
-FP_ARTIFACT = os.path.join(REPO, "results", "fp_microbench.json")
+# artifact paths overridable so tests never clobber a captured TPU result
+ARTIFACT = os.environ.get(
+    "HANDEL_TPU_BENCH_ARTIFACT", os.path.join(REPO, "results", "bench_tpu.json")
+)
+FP_ARTIFACT = os.environ.get(
+    "HANDEL_TPU_BENCH_FP_ARTIFACT",
+    os.path.join(REPO, "results", "fp_microbench.json"),
+)
 REFERENCE_HEADLINE_MS = 900.0  # README.md:32-33, 4000-sig AWS scenario
 
 
@@ -188,10 +194,11 @@ def _fp_microbench() -> None:
 
     from handel_tpu.ops.fp import _throughput_bench
 
+    batch = int(os.environ.get("HANDEL_TPU_BENCH_FP_BATCH", str(1 << 20)))
     with contextlib.redirect_stdout(sys.stderr):
         # the microbench prints a human line; stdout is reserved for the
         # single headline JSON line
-        rate = _throughput_bench(batch=1 << 20, trials=3)
+        rate = _throughput_bench(batch=batch, trials=3)
     os.makedirs(os.path.dirname(FP_ARTIFACT), exist_ok=True)
     with open(FP_ARTIFACT, "w") as f:
         json.dump(
@@ -201,7 +208,7 @@ def _fp_microbench() -> None:
                 "unit": "M muls/s",
                 "backend": jax.default_backend(),
                 "device": str(jax.devices()[0]),
-                "batch": 1 << 20,
+                "batch": batch,
                 "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
             },
             f,
@@ -254,7 +261,10 @@ def main() -> None:
             return
         print(f"bench: measurement child failed (rc={r.returncode})",
               file=sys.stderr)
-    # child died or hung: surface whatever evidence exists
+    # child died or hung: surface whatever evidence exists. Drop the
+    # force-shape hook first — if IT killed the child (bad value), the
+    # inline fallback must still record an honest smoke line
+    os.environ.pop("HANDEL_TPU_BENCH_FORCE_ACCEL_SHAPE", None)
     if not _emit_persisted_or_smoke():
         os.environ["HANDEL_TPU_PLATFORM"] = "cpu"
         _measure()
@@ -276,12 +286,43 @@ def _measure() -> None:
 
     backend = jax.default_backend()
     on_accel = backend not in ("cpu",)
-    # TPU: the 4000-node scenario; CPU fallback: small smoke so the driver
-    # always records a line
-    n_registry = 4096 if on_accel else 16
-    lanes = 128 if on_accel else 4
-    n_candidates = 64 if on_accel else 4
-    trials = 10 if on_accel else 2
+    # test hook: exercise the FULL accelerator measurement path (persist,
+    # provenance, vs_baseline ratio) on the CPU backend with tiny sizes —
+    # this plumbing must not wait for a live tunnel to get its first run
+    # (tests/test_bench.py; round-3 verdict "What's weak" #1)
+    force_shape = os.environ.get("HANDEL_TPU_BENCH_FORCE_ACCEL_SHAPE")
+    if force_shape:
+        if not os.environ.get("HANDEL_TPU_BENCH_ARTIFACT"):
+            # a forced run writing the DEFAULT artifact path would clobber
+            # the real captured TPU evidence with a cpu-backend record
+            print(
+                "bench: HANDEL_TPU_BENCH_FORCE_ACCEL_SHAPE requires "
+                "HANDEL_TPU_BENCH_ARTIFACT to protect results/bench_tpu.json",
+                file=sys.stderr,
+            )
+            raise SystemExit(2)
+        try:
+            n_registry, lanes, n_candidates, trials = (
+                int(x) for x in force_shape.split(",")
+            )
+            if min(n_registry, lanes, n_candidates, trials) < 1:
+                raise ValueError("all fields must be >= 1")
+        except ValueError as e:
+            print(
+                f"bench: bad HANDEL_TPU_BENCH_FORCE_ACCEL_SHAPE "
+                f"{force_shape!r} (want 'registry,lanes,candidates,trials'):"
+                f" {e}",
+                file=sys.stderr,
+            )
+            raise SystemExit(2) from e
+        on_accel = True
+    else:
+        # TPU: the 4000-node scenario; CPU fallback: small smoke so the
+        # driver always records a line
+        n_registry = 4096 if on_accel else 16
+        lanes = 128 if on_accel else 4
+        n_candidates = 64 if on_accel else 4
+        trials = 10 if on_accel else 2
 
     curves = BN254Curves()
     pks, miss_k, args = build_problem(curves, n_registry, lanes, n_candidates)
@@ -310,7 +351,13 @@ def _measure() -> None:
             "value": round(p50, 3),
             "unit": "ms",
             "vs_baseline": round(REFERENCE_HEADLINE_MS / p50, 3),
+            "backend": backend,
         }
+        if force_shape:
+            # a forced tiny-shape run must never read as a real accelerator
+            # measurement on the one-line contract
+            line["forced_shape"] = True
+            line["vs_baseline"] = None
         # persist with provenance so a later tunnel outage can't erase it
         os.makedirs(os.path.dirname(ARTIFACT), exist_ok=True)
         with open(ARTIFACT, "w") as f:
