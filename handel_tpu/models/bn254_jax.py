@@ -105,7 +105,9 @@ class BN254Device:
             self._neg_kernel = jax.jit(self.curves.F.neg)
             self._b2x = T.f2_pack([self.ref.G2_GEN[0]])
             self._b2y = T.f2_pack([self.ref.G2_GEN[1]])
-            self._range_agg_kernels: dict[int, callable] = {}
+        # staged-kernel cache: used by every mesh launch and by any caller
+        # profiling the aggregation stage standalone on one device
+        self._range_agg_kernels: dict[int, callable] = {}
         self._h_cache: dict[bytes, tuple] = {}
         # prefix table: slot i = sum of registry keys [0, i) in affine, with
         # an explicit infinity flag (slot 0). Built lazily on the first

@@ -54,6 +54,14 @@ class BN254Pairing:
         self.curves = curves or self._default_curves()
         self.F: Field = self.curves.F
         self.T: Tower = self.curves.T
+        # Note on static unrolling: emitting the Miller loop's 64 steps as
+        # straight-line code (skipping the ~39 0-bit add branches the scan
+        # computes and discards) was measured and REJECTED — the ~60x-larger
+        # graph OOM-kills both the XLA CPU compiler (128 GB RSS) and this
+        # environment's remote TPU compile helper (13.5 MB MLIR -> SIGKILL).
+        # The windowed pow chains (Tower.f12_pow_const, w=4) capture the
+        # same class of savings for the final exponentiation in scan-sized
+        # graphs instead.
         # psi-Frobenius constants for the ate correction points
         # (bn254_ref.miller_loop_projective: gamma_2 for x, gamma_3 for y)
         self._g2c = self.curves.params._GAMMA[2]

@@ -422,27 +422,57 @@ class Tower:
     def f12_frobenius2(self, a):
         return self.f12_frobenius(self.f12_frobenius(a))
 
-    def f12_pow_const(self, a, e: int, cyclo: bool = False):
-        """a^e for a fixed public exponent via lax.scan (square + selected
-        multiply per bit): keeps the traced graph ~60x smaller than unrolling,
-        which matters for XLA compile times (task spec: compiler-friendly
-        control flow). cyclo=True uses the 3x-cheaper cyclotomic squaring —
-        only valid when a lives in the cyclotomic subgroup (final exp)."""
+    def f12_pow_const(self, a, e: int, cyclo: bool = False, unroll: bool = False):
+        """a^e for a fixed public exponent. cyclo=True uses the 3x-cheaper
+        cyclotomic squaring — only valid when a lives in the cyclotomic
+        subgroup (final exp).
+
+        Two lowerings, same algebra:
+          * scan (default): square + selected multiply per bit — keeps the
+            traced graph ~60x smaller than unrolling, which matters for XLA
+            compile times (task spec: compiler-friendly control flow).
+          * unroll: python loop over the statically-known bits, emitting the
+            multiply ONLY on 1-bits, at a graph that grows with bits(e). No
+            production caller opts in — this environment's compilers cannot
+            absorb pairing-sized unrolled graphs (BN254Pairing.__init__
+            note) — but the lowering is kept, tested at small exponents, for
+            co-located deployments whose compiler can."""
         import jax
 
+        from handel_tpu.ops.fp import windowed_pow
+
         sqr = self.f12_cyclo_sqr if cyclo else self.f12_sqr
-        bits = jnp.asarray([int(c) for c in bin(e)[2:]], jnp.uint32)
+        if unroll:
+            # static bit chain: only the 1-bit multiplies are emitted. The
+            # graph grows with bits(e); fine for the small exponents the
+            # flag is tested with, and an option for co-located deployments
+            # whose compiler absorbs large graphs (this environment's remote
+            # compile helper cannot — see BN254Pairing docstring note)
+            acc = a
+            for c in bin(e)[3:]:
+                acc = sqr(acc)
+                if c == "1":
+                    acc = self.f12_mul(acc, a)
+            return acc
 
-        def step(acc, bit):
-            acc = sqr(acc)
-            mult = self.f12_mul(acc, a)
-            acc = self.f12_select(jnp.broadcast_to(bit == 1, acc[0][0][0].shape[1:]), mult, acc)
-            return acc, None
+        # windowed digit scan — for the 63-bit BN U: 29 executed f12_muls
+        # per chain instead of the bit-scan's 62, same squaring count
+        return windowed_pow(
+            a,
+            e,
+            4,
+            mul=self.f12_mul,
+            sqr=sqr,
+            stack=lambda t: jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *t
+            ),
+            take=lambda s, i: jax.tree_util.tree_map(lambda x: x[i], s),
+            select=lambda c, x, y: self.f12_select(
+                jnp.broadcast_to(c, x[0][0][0].shape[1:]), x, y
+            ),
+        )
 
-        acc, _ = jax.lax.scan(step, a, bits[1:])
-        return acc
-
-    def f12_pow_u(self, a, cyclo: bool = False):
+    def f12_pow_u(self, a, cyclo: bool = False, unroll: bool = False):
         """a^U for the BN parameter U (BN254 tower only).
 
         BLS parameter sets define no U (they expose X instead and override
@@ -455,7 +485,7 @@ class Tower:
                 f"{type(self.params).__name__} has none (BLS towers use "
                 f"their own final-exp chain)"
             )
-        return self.f12_pow_const(a, U, cyclo=cyclo)
+        return self.f12_pow_const(a, U, cyclo=cyclo, unroll=unroll)
 
     # -- host conversions ---------------------------------------------------
 

@@ -1,0 +1,130 @@
+"""Stage-level profile of the flagship verify launch on the live chip.
+
+Decomposes the bench headline (results/bench_tpu.json: 4096-key registry,
+128 lanes, p50 111.5 ms) into:
+
+  * dispatch round-trip — a null jitted op with device-resident input and a
+    16-word fetch, measuring the axon-tunnel floor every launch pays;
+  * range aggregation — the prefix-table G2 stage alone;
+  * Miller loop — batched ate loop at the launch's 2C lane count;
+  * final exponentiation — the shared final-exp at the same lane count;
+  * full launch — the production `_verify_batch_range` p50, for reconciling
+    the stage sum against the headline.
+
+The point: the tunnel RT is environment overhead a co-located host would not
+pay, and the compute split tells us which kernel to optimize for the
+headline. Writes results/verify_profile.json.
+
+    python scripts/verify_profile.py [trials]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from handel_tpu.utils.jaxenv import apply_platform_env
+
+apply_platform_env()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def p50(fn, force, trials: int) -> float:
+    force(fn())  # warm/compile
+    ts = []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        force(fn())
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts) * 1e3)
+
+
+def main() -> int:
+    trials = int(sys.argv[1]) if len(sys.argv) > 1 else 9
+    from bench import build_problem
+    from handel_tpu.models.bn254 import BN254PublicKey
+    from handel_tpu.models.bn254_jax import BN254Device
+    from handel_tpu.ops import bn254_ref as bn
+    from handel_tpu.ops.curve import BN254Curves
+
+    n_registry, lanes, n_cands = 4096, 128, 64
+    curves = BN254Curves()
+    pks, miss_k, args = build_problem(curves, n_registry, lanes, n_cands)
+    dev = BN254Device(
+        [BN254PublicKey(p) for p in pks], batch_size=lanes, curves=curves
+    )
+    lo, hi, miss_idx, miss_ok, sig_x, sig_y, h_x, h_y, valid = args
+
+    out: dict[str, float] = {}
+    force = lambda r: jax.device_get(jax.tree_util.tree_leaves(r)[0])
+
+    # 1. dispatch round-trip floor
+    x = jnp.ones((8, 128), jnp.uint32)
+    null = jax.jit(lambda v: v + 1)
+    out["dispatch_rt_ms"] = p50(lambda: null(x)[:1, :1], force, trials)
+
+    # 2. range aggregation alone (prefix-table G2 stage)
+    agg_fn = dev._range_agg_kernel(miss_k)
+    mk_agg = lambda: agg_fn(lo, hi, miss_idx, miss_ok)
+    out["range_agg_ms"] = p50(mk_agg, force, trials)
+    agg = mk_agg()
+
+    # 3/4. pairing stages at the launch's lane count (2C: H-lane + sig-lane)
+    g2 = curves.g2
+    qx, qy, _ = jax.jit(g2.to_affine)(agg)
+    b2x = curves.T.f2_pack([bn.G2_GEN[0]] * 1)
+    b2y = curves.T.f2_pack([bn.G2_GEN[1]] * 1)
+    C = lanes
+    px = jnp.concatenate([jnp.broadcast_to(h_x, sig_x.shape), sig_x], axis=1)
+    py = jnp.concatenate(
+        [jnp.broadcast_to(h_y, sig_y.shape), jax.jit(curves.F.neg)(sig_y)], axis=1
+    )
+    qx2 = tuple(
+        jnp.concatenate([qx[i], jnp.broadcast_to(b2x[i], qx[i].shape)], axis=1)
+        for i in range(2)
+    )
+    qy2 = tuple(
+        jnp.concatenate([qy[i], jnp.broadcast_to(b2y[i], qy[i].shape)], axis=1)
+        for i in range(2)
+    )
+    mask = jnp.concatenate([valid, valid])
+
+    pair = dev.pairing
+    miller = jax.jit(lambda p, q, m: pair.miller_loop(p, q, m))
+    out["miller_loop_2c_ms"] = p50(
+        lambda: miller((px, py), (qx2, qy2), mask), force, trials
+    )
+    f = miller((px, py), (qx2, qy2), mask)
+    fexp = jax.jit(pair.final_exp)
+    out["final_exp_2c_ms"] = p50(lambda: fexp(f), force, trials)
+
+    # 5. the full production launch (the headline path)
+    kern = dev._range_kernel(miss_k)
+    out["full_launch_ms"] = p50(
+        lambda: kern(lo, hi, miss_idx, miss_ok, sig_x, sig_y, h_x, h_y, valid),
+        force,
+        trials,
+    )
+
+    out["backend"] = jax.default_backend()
+    out["device"] = str(jax.devices()[0])
+    out["trials"] = trials
+    out["registry"], out["lanes"], out["candidates"] = n_registry, lanes, n_cands
+    print(json.dumps(out, indent=1))
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                        "results", "verify_profile.json")
+    with open(os.path.normpath(path), "w") as fh:
+        json.dump(out, fh, indent=1)
+        fh.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -60,8 +60,9 @@ def test_accel_measurement_path_persists_artifact(tmp_path):
     assert "captured_at" in art
 
     fp = json.load(open(tmp_path / "fp.json"))
-    assert fp["metric"] == "fp254_mont_mul_throughput"
+    assert fp["metric"] == "fp254_mont_mul_throughput_marginal"
     assert fp["value"] > 0
+    assert fp["dispatch_floor_ms"] >= 0
 
 
 def test_persisted_artifact_reemitted_on_outage(tmp_path):
@@ -82,9 +83,10 @@ def test_persisted_artifact_reemitted_on_outage(tmp_path):
         os.environ,
         HANDEL_TPU_BENCH_ARTIFACT=str(tmp_path / "bench_tpu.json"),
         HANDEL_TPU_PROBE_BUDGET_S="1",
-        # deterministic probe failure: an unknown platform errors instantly
-        # (probing the real tunnel would make this test depend on its state)
-        JAX_PLATFORMS="definitely-not-a-platform",
+        # deterministic probe failure: a live tunnel must not flip this test
+        # onto the measurement path (sitecustomize overrides JAX_PLATFORMS,
+        # so masking the platform name alone cannot force the outage)
+        HANDEL_TPU_BENCH_FORCE_PROBE_FAIL="1",
     )
     env.pop("HANDEL_TPU_PLATFORM", None)  # force the probe path
     r = subprocess.run(

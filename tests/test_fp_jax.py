@@ -79,6 +79,30 @@ def test_pow_const_and_inv(F):
     assert F.unpack(inv) == [pow(x, -1, bn.P) for x in xs]
 
 
+def test_pow_const_windowed_edges(F):
+    """The windowed digit scan across its edge shapes: exponents at/below the
+    window width (direct-chain branch), widths that pad, digits of 0 (skip
+    lanes), and agreement with python pow on irregular bit patterns."""
+    xs = rand_elems(3)
+    ax = F.pack(xs)
+    for e in (2, 3, 15, 16, 17, 0x8001, 0x10010, 0xF0F0F0F, bn.P - 2):
+        got = F.unpack(jax.jit(lambda a, e=e: F.pow_const(a, e))(ax))
+        assert got == [pow(x, e, bn.P) for x in xs], f"e={e:#x}"
+
+
+def test_windowed_pow_digits():
+    from handel_tpu.ops.fp import windowed_pow_digits
+
+    assert windowed_pow_digits(9, 4) is None  # <= window bits: direct chain
+    assert windowed_pow_digits(0x1F, 4) == [1, 15]  # left-pad keeps MSB != 0
+    assert windowed_pow_digits(0x100, 4) == [1, 0, 0]  # zero digits preserved
+    digits = windowed_pow_digits(bn.P - 2, 4)
+    acc = 0
+    for d in digits:
+        acc = (acc << 4) | d
+    assert acc == bn.P - 2  # decomposition is exact
+
+
 def test_eq_is_zero_select(F):
     xs = [0, 5, 7, 0]
     ys = [0, 5, 8, 1]

@@ -85,6 +85,25 @@ def test_f12_pow_u(T):
     assert got == [bn.f12_pow(x, bn.U) for x in xs]
 
 
+def test_f12_pow_const_windowed_and_unroll(T):
+    """Small exponents keep both lowerings compile-cheap on CPU: the windowed
+    digit scan (production path) and the static unroll (the flag offered to
+    co-located deployments) must agree with the oracle — the unroll branch
+    would otherwise rot untested since no default path takes it."""
+    xs = rand_f12s(2)
+    ax = T.f12_pack(xs)
+    for e in (3, 16, 0x1D, 0x113):
+        want = [bn.f12_pow(x, e) for x in xs]
+        windowed = T.f12_unpack(
+            jax.jit(lambda a, e=e: T.f12_pow_const(a, e))(ax)
+        )
+        assert windowed == want, f"windowed e={e:#x}"
+        unrolled = T.f12_unpack(
+            jax.jit(lambda a, e=e: T.f12_pow_const(a, e, unroll=True))(ax)
+        )
+        assert unrolled == want, f"unroll e={e:#x}"
+
+
 def test_f6_mul_v_and_select(T):
     import jax.numpy as jnp
 
