@@ -24,7 +24,7 @@ Design:
     tunneled chip pays a ~68 ms host<->device round trip per dispatch that
     dwarfs the kernel (a naive time-one-call loop reads 15.5M/s and is
     measuring the tunnel, not the VPU — see `_throughput_bench`). The
-    dispatch floor, not mul throughput, dominates the 111.5 ms 128-lane
+    dispatch floor, not mul throughput, dominates the ~104 ms 128-lane
     verify p50 (results/verify_profile.json breaks the launch down).
   * **Batch stacking beats vmap.** Callers (ops/tower.py) flatten independent
     field muls into the batch dimension (one Fp12 mul = ONE mont_mul call at
