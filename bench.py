@@ -129,25 +129,44 @@ def _emit_persisted_or_smoke() -> bool:
     return False
 
 
-def build_problem(curves, n_registry: int, lanes: int, n_candidates: int):
+def build_problem(
+    curves,
+    n_registry: int,
+    lanes: int,
+    n_candidates: int,
+    ref=None,
+    g1_mul_batch=None,
+    g2_mul_batch=None,
+    miss_k: int = 8,
+    seed: int = 2024,
+):
     """Handel-realistic candidate batch: contiguous partitioner ranges with a
     few offline holes, exactly the traffic `batch_verify` sees. Returns the
     range-kernel argument tuple (lo, hi, miss_idx, miss_ok, sig, h, valid)
-    plus the keypair material."""
+    plus the keypair material.
+
+    Curve-parametric (scripts/bench_bls12.py reuses it for BLS12-381):
+    `ref` is the scalar-oracle module (G1_GEN/G2_GEN/R) and the *_mul_batch
+    hooks do host keygen — defaults are BN254 through the native C++ path.
+    """
     import jax.numpy as jnp
     import numpy as np
 
-    from handel_tpu import native as nat
-    from handel_tpu.ops import bn254_ref as bn
+    if ref is None:
+        from handel_tpu import native as nat
+        from handel_tpu.ops import bn254_ref as ref
 
-    rng = random.Random(2024)
+        g1_mul_batch = nat.g1_mul_batch
+        g2_mul_batch = nat.g2_mul_batch
+    bn = ref
+
+    rng = random.Random(seed)
     # small scalars keep host-side keygen fast; verification cost on device
     # is independent of scalar magnitude
     sks = [rng.randrange(1, 1 << 30) for _ in range(n_registry)]
-    pks = nat.g2_mul_batch([bn.G2_GEN] * n_registry, sks)
-    h = nat.g1_mul(bn.G1_GEN, rng.randrange(1, bn.R))
+    pks = g2_mul_batch([bn.G2_GEN] * n_registry, sks)
+    h = g1_mul_batch([bn.G1_GEN], [rng.randrange(1, bn.R)])[0]
 
-    miss_k = 8  # up to 8 offline signers patched per candidate
     lo = np.zeros((lanes,), np.int32)
     hi = np.zeros((lanes,), np.int32)
     miss_idx = np.zeros((miss_k, lanes), np.int64)
@@ -168,7 +187,7 @@ def build_problem(curves, n_registry: int, lanes: int, n_candidates: int):
         miss_ok[: len(holes), j] = True
         signers = set(range(int(lo[j]), int(hi[j]))) - set(holes)
         agg_sks.append(sum(sks[i] for i in signers) % bn.R)
-    sig_pts = nat.g1_mul_batch([h] * n_candidates, agg_sks)
+    sig_pts = g1_mul_batch([h] * n_candidates, agg_sks)
     sig_pts += [bn.G1_GEN] * (lanes - n_candidates)
 
     F = curves.F
