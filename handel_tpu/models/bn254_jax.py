@@ -77,7 +77,7 @@ class BN254Device:
         self._reg_y = T.f2_pack([p[1] for p in pts])
         # multi-chip plane (SURVEY.md §5.7): registry shards over the mesh
         # for the masked G2 segment-sum, candidate lanes shard for the
-        # pairing check. Same host entry points — `_one_launch` dispatches to
+        # pairing check. Same host entry points — `_dispatch_one` routes to
         # a STAGED pipeline of separate executables (sharded sum / range
         # aggregation -> affine epilogue -> sharded pairing check) instead of
         # the single-device monolithic kernels: nesting shard_map regions
@@ -312,17 +312,44 @@ class BN254Device:
         requests: Sequence[tuple[BitSet, BN254Signature]],
     ) -> list[bool]:
         """Verify up to batch_size (global bitset, aggregate sig) candidates
-        in one device launch; longer request lists run in several launches."""
+        in one device launch; longer request lists run in several launches.
+
+        Launches are PIPELINED: every chunk is dispatched (enqueued on the
+        device — jax dispatch is async) before the first verdict array is
+        pulled back to the host, so the per-dispatch round trip (~66 ms on
+        this environment's tunneled chip, results/verify_profile.json)
+        overlaps chip compute of the launches behind it instead of
+        serializing with it. The reference's loop verifies one signature at
+        a time on the caller's goroutine (processing.go:258-287)."""
+        handles = [
+            self.dispatch(msg, requests[i : i + self.batch_size])
+            for i in range(0, len(requests), self.batch_size)
+        ]
         out: list[bool] = []
-        for i in range(0, len(requests), self.batch_size):
-            out.extend(self._one_launch(msg, requests[i : i + self.batch_size]))
+        for h in handles:
+            out.extend(self.fetch(h))
         return out
+
+    def dispatch(self, msg, requests):
+        """Enqueue one launch (≤ batch_size candidates); returns an opaque
+        handle for `fetch`. On the single-device path the device work is in
+        flight when this returns (jax async dispatch) and `fetch` blocks on
+        the verdicts. On the mesh path the staged pipeline's host glue
+        (`_sharded_tail`) completes the launch before returning — there
+        `fetch` is effectively a no-op and launch wall time lands on the
+        dispatch side of the monitor plane."""
+        return (self._dispatch_one(msg, requests), len(requests))
+
+    def fetch(self, handle) -> list[bool]:
+        """Block until a dispatched launch's verdicts arrive; host-ordered."""
+        verdicts, k = handle
+        return [bool(v) for v in np.asarray(verdicts)[:k]]
 
     # missing-signer patch width cap: candidates whose range hull has more
     # holes than this fall back to the dense masked-sum kernel
     MISS_CAP = 64
 
-    def _one_launch(self, msg, requests) -> list[bool]:
+    def _dispatch_one(self, msg, requests):
         C = self.batch_size
         F = self.curves.F
         sig_pts = []
@@ -412,7 +439,7 @@ class BN254Device:
                     h_y,
                     jnp.asarray(valid),
                 )
-        return [bool(v) for v in np.asarray(verdicts)[: len(requests)]]
+        return verdicts
 
 
 class BN254JaxConstructor(BN254Constructor):

@@ -17,11 +17,12 @@ Design:
   * **Montgomery multiplication** (radix 2^16, CIOS-style column interleave)
     as one fused Pallas kernel: inputs stream HBM->VMEM in (NLIMBS, TILE_B)
     blocks, all ~n^2 limb products and column sums happen in VMEM/registers.
-    Measured 250.6M 254-bit mults/s MARGINAL at B=1M on the one available
-    chip (TPU v5 lite0, results/fp_microbench.json) vs ~1M/s for the naive
-    XLA graph that materializes (B,16,16) intermediates through HBM.
+    Measured 357.0M 254-bit mults/s MARGINAL at B=262144 on the one
+    available chip (TPU v5 lite0, results/fp_microbench.json; run-to-run
+    ~250-436M with tunnel weather) vs ~1M/s for the naive XLA graph that
+    materializes (B,16,16) intermediates through HBM.
     Marginal means chained-muls-in-one-dispatch slope: this environment's
-    tunneled chip pays a ~68 ms host<->device round trip per dispatch that
+    tunneled chip pays a ~57-68 ms host<->device round trip per dispatch that
     dwarfs the kernel (a naive time-one-call loop reads 15.5M/s and is
     measuring the tunnel, not the VPU — see `_throughput_bench`). The
     dispatch floor, not mul throughput, dominates the ~104 ms 128-lane
@@ -80,6 +81,22 @@ def windowed_pow_digits(e: int, window: int) -> list[int] | None:
     return [int(padded[i : i + window], 2) for i in range(0, len(padded), window)]
 
 
+def default_pow_window() -> int:
+    """Backend-aware pow strategy: 4-bit windows on accelerators (~3x fewer
+    executed muls per chain), plain bit scan on XLA:CPU. The windowed form
+    builds a 15-entry table plus a gather-inside-scan at EVERY pow site, and
+    the CPU backend — where only compile time matters (virtual-mesh dryruns,
+    CI) — pays for that in compile seconds multiplied across the staged
+    sharded executables (the r04 multichip-dryrun timeout). The bit scan
+    compiles to the smallest graph; the executed-mul count it wastes is
+    irrelevant off-chip."""
+    try:
+        backend = jax.default_backend()
+    except Exception:
+        backend = "cpu"
+    return 1 if backend == "cpu" else 4
+
+
 def windowed_pow(a, e: int, window: int, mul, sqr, stack, take, select):
     """Left-to-right windowed square-and-multiply, representation-agnostic.
 
@@ -89,10 +106,33 @@ def windowed_pow(a, e: int, window: int, mul, sqr, stack, take, select):
     select), cutting executed muls from bits-1 to 2^w-2 + bits/w while the
     traced graph stays scan-sized (the digit-loop body is traced once).
 
+    window<=1 selects the plain bit scan (scan over bits, square + selected
+    multiply per step, no table/gather) — the compile-cheapest lowering,
+    the right choice where compile time dominates (see default_pow_window).
+
     Primitives: mul(a,b), sqr(a); stack(list_of_elems) -> stacked repr;
     take(stacked, traced_idx) -> elem; select(traced_bool, if_true, if_false).
     """
     import jax
+
+    if window <= 1:
+        bits = bin(e)[2:]
+        if len(bits) <= 8:  # tiny exponent: direct chain
+            acc = a
+            for c in bits[1:]:
+                acc = sqr(acc)
+                if c == "1":
+                    acc = mul(acc, a)
+            return acc
+
+        def bit_step(acc, bit):
+            acc = sqr(acc)
+            return select(bit == 1, mul(acc, a), acc), None
+
+        acc, _ = jax.lax.scan(
+            bit_step, a, jnp.asarray([int(c) for c in bits[1:]], jnp.uint32)
+        )
+        return acc
 
     digits = windowed_pow_digits(e, window)
     if digits is None:  # tiny exponent: direct chain
@@ -442,14 +482,15 @@ class Field:
 
     # -- derived ops --------------------------------------------------------
 
-    def pow_const(self, a, e: int, window: int = 4):
+    def pow_const(self, a, e: int, window: int | None = None):
         """a^e for a fixed public exponent: windowed square-and-multiply
         (`windowed_pow`) — for the 254-bit Fermat inversion, 77 executed
-        muls instead of the bit-scan's 253."""
+        muls instead of the bit-scan's 253 on accelerators; plain bit scan
+        on CPU where compile time dominates (default_pow_window)."""
         return windowed_pow(
             a,
             e,
-            window,
+            default_pow_window() if window is None else window,
             mul=self.mul,
             sqr=lambda x: self.mul(x, x),
             stack=lambda t: jnp.stack(t),

@@ -439,7 +439,7 @@ class Tower:
             co-located deployments whose compiler can."""
         import jax
 
-        from handel_tpu.ops.fp import windowed_pow
+        from handel_tpu.ops.fp import default_pow_window, windowed_pow
 
         sqr = self.f12_cyclo_sqr if cyclo else self.f12_sqr
         if unroll:
@@ -455,12 +455,14 @@ class Tower:
                     acc = self.f12_mul(acc, a)
             return acc
 
-        # windowed digit scan — for the 63-bit BN U: 29 executed f12_muls
-        # per chain instead of the bit-scan's 62, same squaring count
+        # windowed digit scan on accelerators — for the 63-bit BN U: 29
+        # executed f12_muls per chain instead of the bit-scan's 62, same
+        # squaring count; plain bit scan on CPU (default_pow_window: the
+        # per-site table+gather is a compile-time tax the CPU gate can't pay)
         return windowed_pow(
             a,
             e,
-            4,
+            default_pow_window(),
             mul=self.f12_mul,
             sqr=sqr,
             stack=lambda t: jax.tree_util.tree_map(

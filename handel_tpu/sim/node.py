@@ -100,13 +100,20 @@ async def run_node_process(args) -> int:
         # calls reuse the same registry upload + executables
         device = scheme.constructor.prepare(registry.public_keys())
         # kernel-time trace hook (SURVEY.md §5.1): every shared launch's
-        # wall time lands on the monitor plane
-        launch_timer = KernelTimer(device.batch_verify, name="launch")
-        device.batch_verify = launch_timer
+        # wall time lands on the monitor plane. The timer sits on fetch
+        # (verdict-arrival latency) + dispatch (host prep/enqueue) because
+        # the pipelined service calls those directly; device.batch_verify
+        # routes through the same instance attributes, so direct calls are
+        # timed too
+        launch_timer = KernelTimer(device.fetch, name="launch")
+        device.fetch = launch_timer
+        dispatch_timer = KernelTimer(device.dispatch, name="dispatch")
+        device.dispatch = dispatch_timer
         shared_service = BatchVerifierService(device)
         if plane is not None:
             plane.add("verifier", shared_service)
             plane.add("launch", launch_timer)
+            plane.add("dispatch", dispatch_timer)
         if args.serve_verifier:
             # this is the fleet's device host: serve the batch plane to
             # every chip-less process BEFORE the START barrier, so remote

@@ -2,8 +2,11 @@
 and raced against the production `Field.mul`.
 
 Motivation (results/fp_microbench.json): the production CIOS kernel measures
-15.5M 254-bit muls/s on the one available chip, and the pairing p50 is
-field-mul-bound — any mul speedup divides the headline verify latency. The
+~357M 254-bit muls/s MARGINAL on the one available chip (the 15.5M/s figure
+once cited here was a tunnel-dispatch artifact — see `Field._throughput_bench`),
+and the verify p50 is dominated by the ~66 ms dispatch floor, not field muls.
+The lab's goal is therefore chip-side compute for co-located deployments,
+where the dispatch floor vanishes and mul throughput is the bound again. The
 production kernel body (`Field._mul_cols`) accumulates columns with per-limb
 (B,)-shaped 1-D ops; on TPU a 1-D vector occupies one sublane of the (8, 128)
 VPU tile, so up to 7/8 of the unit idles. The variants here restructure the
@@ -268,27 +271,40 @@ def main() -> int:
     on_tpu = jax.default_backend() != "cpu"
     print(f"backend={jax.default_backend()} batch={batch}")
 
-    candidates: list[tuple[str, object]] = [("prod(Field.mul)", jax.jit(F.mul))]
+    # (name, bench_fn, validate_fn): pallas builds are shape-specialized to
+    # the bench batch with a fixed grid, so they are validated through a
+    # SEPARATE small-batch build of the same body — validating the bench
+    # build with 256-wide inputs would shape-mismatch every pallas variant
+    # out of the race (advisor finding, r04). One shared small-batch build
+    # per body: the tile variants share algebra, so revalidating per tile
+    # would only re-pay compiles. Non-pallas entries validate the bench fn
+    # itself (shape-polymorphic).
+    prod = jax.jit(F.mul)
+    candidates: list[tuple[str, object, object]] = [
+        ("prod(Field.mul)", prod, prod)
+    ]
     for nm, body in (
         ("cios_fullwidth", lab.cios_fullwidth_body),
         ("separated", lab.separated_body),
     ):
-        candidates.append((f"xla:{nm}", lab.jit_xla(body)))
+        xla_fn = lab.jit_xla(body)
+        candidates.append((f"xla:{nm}", xla_fn, xla_fn))
         if on_tpu:
+            vfn = lab.jit_pallas(body, 256, 256)
             for tile in (256, 512, 1024, 2048):
                 candidates.append(
-                    (f"pallas:{nm}:t{tile}", lab.jit_pallas(body, batch, tile))
+                    (f"pallas:{nm}:t{tile}", lab.jit_pallas(body, batch, tile), vfn)
                 )
 
-    for nm, fn in candidates:
+    for nm, _fn, vfn in candidates:
         try:
-            validate(F, fn)
+            validate(F, vfn)
             print(f"  {nm:28s} validate: OK")
         except Exception as e:  # noqa: BLE001
             print(f"  {nm:28s} validate: FAIL ({type(e).__name__}: {e})")
             candidates = [c for c in candidates if c[0] != nm]
     print("-- timing --")
-    for nm, fn in candidates:
+    for nm, fn, _vfn in candidates:
         try:
             bench(nm, fn, a, b)
         except Exception as e:  # noqa: BLE001

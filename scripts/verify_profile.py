@@ -1,7 +1,7 @@
 """Stage-level profile of the flagship verify launch on the live chip.
 
 Decomposes the bench headline (results/bench_tpu.json: 4096-key registry,
-128 lanes, p50 111.5 ms) into:
+128 lanes, p50 101.3 ms) into:
 
   * dispatch round-trip — a null jitted op with device-resident input and a
     16-word fetch, measuring the axon-tunnel floor every launch pays;
@@ -112,6 +112,31 @@ def main() -> int:
         force,
         trials,
     )
+
+    # 6. pipelined sustained rate: dispatch a window of launches
+    #    back-to-back and block only on the last (the chip executes
+    #    in order, so the last completing implies all did). The tunnel
+    #    round trip then overlaps on-chip compute of the launches behind
+    #    it — this is the effective per-batch latency the pipelined
+    #    BatchVerifierService (parallel/batch_verifier.py) sustains,
+    #    vs the single-shot full_launch_ms above.
+    depth = 8
+
+    def pipelined() -> None:
+        rs = [
+            kern(lo, hi, miss_idx, miss_ok, sig_x, sig_y, h_x, h_y, valid)
+            for _ in range(depth)
+        ]
+        force(rs[-1])
+
+    pipelined()  # warm
+    ts = []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        pipelined()
+        ts.append((time.perf_counter() - t0) / depth)
+    out["pipelined_depth"] = depth
+    out["pipelined_per_launch_ms"] = float(np.median(ts) * 1e3)
 
     out["backend"] = jax.default_backend()
     out["device"] = str(jax.devices()[0])
