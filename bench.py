@@ -105,6 +105,55 @@ def _emit(line: dict) -> None:
     print(json.dumps(line))
 
 
+def write_json_atomic(path: str, obj: dict) -> None:
+    """All evidence-artifact writers go through here: unique temp +
+    os.replace so a watchdog kill mid-write can never truncate an
+    already-captured artifact, and two concurrent writers (bench.py and the
+    lab scripts share results/fp_microbench.json) can't interleave on one
+    scratch file (the corrupt-read guards downstream are a second line of
+    defense, not a license to write non-atomically). Newline-terminated so
+    the committed file's final byte doesn't flap between writers."""
+    import tempfile
+
+    fd, tmp = tempfile.mkstemp(
+        dir=os.path.dirname(path) or ".", suffix=".tmp"
+    )
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(obj, f, indent=1)
+            f.write("\n")
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+PIPELINE_DEPTH = 8
+
+
+def measure_pipelined(launch, block, trials: int, depth: int = PIPELINE_DEPTH):
+    """Sustained per-launch latency, ms: dispatch `depth` launches
+    back-to-back and block only on the last (the chip executes in order, so
+    the last completing implies all did) — the per-dispatch tunnel round
+    trip then overlaps on-chip compute of the queued launches, which is how
+    production traffic flows through the two-stage BatchVerifierService
+    (parallel/batch_verifier.py). ONE copy of the methodology: bench.py and
+    scripts/verify_profile.py must publish figures measured identically.
+    """
+    rs = [launch() for _ in range(depth)]
+    block(rs[-1])  # warm
+    out = []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        rs = [launch() for _ in range(depth)]
+        block(rs[-1])
+        out.append((time.perf_counter() - t0) * 1000.0 / depth)
+    return out
+
+
 def _emit_persisted_or_smoke() -> bool:
     """Fallback path when no accelerator is reachable: re-emit the round's
     persisted TPU artifact if one exists. Returns True if emitted."""
@@ -112,17 +161,21 @@ def _emit_persisted_or_smoke() -> bool:
         with open(ARTIFACT) as f:
             art = json.load(f)
         if art.get("backend") not in (None, "cpu"):
-            _emit(
-                {
-                    "metric": art["metric"],
-                    "value": art["value"],
-                    "unit": art["unit"],
-                    "vs_baseline": art.get("vs_baseline"),
-                    "source": "persisted",
-                    "backend": art.get("backend"),
-                    "captured_at": art.get("captured_at"),
-                }
-            )
+            line = {
+                "metric": art["metric"],
+                "value": art["value"],
+                "unit": art["unit"],
+                "vs_baseline": art.get("vs_baseline"),
+                "source": "persisted",
+                "backend": art.get("backend"),
+                "captured_at": art.get("captured_at"),
+            }
+            # the pipelined sustained-rate figures ride the same
+            # outage-persistence contract as the headline p50
+            for k in ("pipelined_p50_ms", "pipelined_vs_baseline"):
+                if k in art:
+                    line[k] = art[k]
+            _emit(line)
             return True
     except (OSError, ValueError, KeyError):
         pass
@@ -235,25 +288,35 @@ def _fp_microbench() -> None:
         )
         return
     os.makedirs(os.path.dirname(FP_ARTIFACT), exist_ok=True)
-    with open(FP_ARTIFACT, "w") as f:
-        json.dump(
-            {
-                "metric": "fp254_mont_mul_throughput_marginal",
-                "value": round(rate / 1e6, 1),
-                # rate 0.0 = the marginal slope was not measurable (timing
-                # noise at this batch); an explicit marker, never a made-up
-                # number (_throughput_bench retries once, then gives up)
-                "invalid_measurement": rate <= 0,
-                "unit": "M muls/s",
-                "dispatch_floor_ms": round(floor * 1e3, 1),
-                "backend": jax.default_backend(),
-                "device": str(jax.devices()[0]),
-                "batch": batch,
-                "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-            },
-            f,
-            indent=1,
-        )
+    # carry forward side-channel captures (scripts/mxu_limb_lab.py merges
+    # an "mxu_lab" entry into this artifact): overwriting with only our
+    # own keys would destroy captured evidence
+    extra = {}
+    if os.path.exists(FP_ARTIFACT):
+        try:
+            with open(FP_ARTIFACT) as f:
+                prev = json.load(f)
+            extra = {k: prev[k] for k in ("mxu_lab",) if k in prev}
+        except (json.JSONDecodeError, OSError):
+            pass
+    write_json_atomic(
+        FP_ARTIFACT,
+        {
+            "metric": "fp254_mont_mul_throughput_marginal",
+            "value": round(rate / 1e6, 1),
+            # rate 0.0 = the marginal slope was not measurable (timing
+            # noise at this batch); an explicit marker, never a made-up
+            # number (_throughput_bench retries once, then gives up)
+            "invalid_measurement": rate <= 0,
+            "unit": "M muls/s",
+            "dispatch_floor_ms": round(floor * 1e3, 1),
+            "backend": jax.default_backend(),
+            "device": str(jax.devices()[0]),
+            "batch": batch,
+            "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            **extra,
+        },
+    )
 
 
 def main() -> None:
@@ -398,12 +461,14 @@ def _measure() -> None:
             # measurement on the one-line contract
             line["forced_shape"] = True
             line["vs_baseline"] = None
-        # persist with provenance so a later tunnel outage can't erase it
-        os.makedirs(os.path.dirname(ARTIFACT), exist_ok=True)
-        with open(ARTIFACT, "w") as f:
-            json.dump(
+
+        def persist(extra_line: dict) -> None:
+            # provenance so a later tunnel outage can't erase the capture
+            os.makedirs(os.path.dirname(ARTIFACT), exist_ok=True)
+            write_json_atomic(
+                ARTIFACT,
                 {
-                    **line,
+                    **extra_line,
                     "backend": backend,
                     "device": str(jax.devices()[0]),
                     "device_count": jax.device_count(),
@@ -415,9 +480,31 @@ def _measure() -> None:
                         "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
                     ),
                 },
-                f,
-                indent=1,
             )
+
+        # persist the headline BEFORE the pipelined extension: those extra
+        # launches ride the same flaky tunnel, and a hang there kills this
+        # child via the parent watchdog — the already-measured p50 must
+        # already be on disk so the parent's fallback re-emits it
+        persist(line)
+
+        # pipelined sustained rate (measure_pipelined above). Accel-only:
+        # the CPU smoke line never reports it, so the degraded path skips
+        # the extra launches.
+        try:
+            pipe_times = measure_pipelined(
+                lambda: kernel(*args), lambda r: r.block_until_ready(), trials
+            )
+            pipe_p50 = float(np.percentile(pipe_times, 50))
+            line["pipelined_p50_ms"] = round(pipe_p50, 3)
+            line["pipelined_vs_baseline"] = (
+                None if force_shape else round(REFERENCE_HEADLINE_MS / pipe_p50, 3)
+            )
+            persist(line)
+        except Exception as e:
+            # degrade to headline-only, never lose the p50 over the extension
+            print(f"bench: pipelined extension failed: {e}", file=sys.stderr)
+
         # headline line FIRST: a tunnel drop during the fp microbench must
         # not cost an already-captured measurement
         _emit(line)

@@ -27,6 +27,7 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from bench import PIPELINE_DEPTH, measure_pipelined, write_json_atomic
 from handel_tpu.utils.jaxenv import apply_platform_env
 
 apply_platform_env()
@@ -113,30 +114,17 @@ def main() -> int:
         trials,
     )
 
-    # 6. pipelined sustained rate: dispatch a window of launches
-    #    back-to-back and block only on the last (the chip executes
-    #    in order, so the last completing implies all did). The tunnel
-    #    round trip then overlaps on-chip compute of the launches behind
-    #    it — this is the effective per-batch latency the pipelined
-    #    BatchVerifierService (parallel/batch_verifier.py) sustains,
-    #    vs the single-shot full_launch_ms above.
-    depth = 8
-
-    def pipelined() -> None:
-        rs = [
-            kern(lo, hi, miss_idx, miss_ok, sig_x, sig_y, h_x, h_y, valid)
-            for _ in range(depth)
-        ]
-        force(rs[-1])
-
-    pipelined()  # warm
-    ts = []
-    for _ in range(trials):
-        t0 = time.perf_counter()
-        pipelined()
-        ts.append((time.perf_counter() - t0) / depth)
-    out["pipelined_depth"] = depth
-    out["pipelined_per_launch_ms"] = float(np.median(ts) * 1e3)
+    # 6. pipelined sustained rate — the shared methodology (bench.py
+    #    measure_pipelined): the effective per-batch latency the pipelined
+    #    BatchVerifierService (parallel/batch_verifier.py) sustains, vs
+    #    the single-shot full_launch_ms above.
+    ts = measure_pipelined(
+        lambda: kern(lo, hi, miss_idx, miss_ok, sig_x, sig_y, h_x, h_y, valid),
+        force,
+        trials,
+    )
+    out["pipelined_depth"] = PIPELINE_DEPTH
+    out["pipelined_per_launch_ms"] = float(np.median(ts))
 
     out["backend"] = jax.default_backend()
     out["device"] = str(jax.devices()[0])
@@ -145,9 +133,7 @@ def main() -> int:
     print(json.dumps(out, indent=1))
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
                         "results", "verify_profile.json")
-    with open(os.path.normpath(path), "w") as fh:
-        json.dump(out, fh, indent=1)
-        fh.write("\n")
+    write_json_atomic(os.path.normpath(path), out)
     return 0
 
 
