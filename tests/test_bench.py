@@ -9,6 +9,13 @@ import os
 import subprocess
 import sys
 
+import pytest
+
+# slow tier: each test runs bench.py as a subprocess that compiles the
+# verify kernel from scratch (XLA-compile-bound, ~10 min on one core) —
+# runs in test-slow/test-all (nightly/CI)
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH = os.path.join(REPO, "bench.py")
 

@@ -17,6 +17,11 @@ import numpy as np
 import jax.numpy as jnp
 import pytest
 
+# slow tier: XLA-compile-bound (381-bit kernel graphs) — runs in
+# test-slow/test-all (nightly/CI); the fast tier keeps the oracle +
+# protocol + sharding guards
+pytestmark = pytest.mark.slow
+
 from handel_tpu.ops import bls12_381_ref as bls
 from handel_tpu.ops.curve import BLS12Curves
 from handel_tpu.ops.pairing import BLS12Pairing
@@ -99,7 +104,6 @@ def test_pairing_check_bls_verify(stack):
     assert verdicts.tolist() == [True] * (B - 1) + [False]
 
 
-@pytest.mark.slow
 def test_device_scheme_batch_verify():
     """models/bls12_381_jax.py end-to-end: host keygen/sign, device verify
     through the Constructor interface (batch of 4: 3 valid + 1 forged)."""

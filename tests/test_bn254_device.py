@@ -11,6 +11,11 @@ import random
 
 import pytest
 
+# slow tier: XLA-compile-bound (device verify kernels) — runs in
+# test-slow/test-all (nightly/CI); the fast tier keeps the oracle +
+# protocol + sharding guards
+pytestmark = pytest.mark.slow
+
 from handel_tpu.core.bitset import BitSet
 from handel_tpu.models.bn254 import BN254PublicKey, BN254Signature, hash_to_g1
 from handel_tpu.models.bn254_jax import BN254Device

@@ -6,6 +6,11 @@ import random
 import numpy as np
 import pytest
 
+# slow tier: XLA-compile-bound (curve op graphs) — runs in
+# test-slow/test-all (nightly/CI); the fast tier keeps the oracle +
+# protocol + sharding guards
+pytestmark = pytest.mark.slow
+
 from handel_tpu.ops import bn254_ref as bn
 from handel_tpu.ops.curve import BN254Curves
 

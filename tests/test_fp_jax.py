@@ -12,6 +12,11 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+# slow tier: XLA-compile-bound (every property test jits fresh field
+# kernels) — runs in test-slow/test-all (nightly/CI); the fast tier keeps
+# the oracle + protocol + sharding guards
+pytestmark = pytest.mark.slow
+
 from handel_tpu.ops import bn254_ref as bn
 from handel_tpu.ops.fp import Field, LIMB_MASK
 

@@ -14,6 +14,11 @@ import random
 import jax
 import pytest
 
+# slow tier: XLA-compile-bound (pairing graphs, minutes each cold) — runs in
+# test-slow/test-all (nightly/CI); the fast tier keeps the oracle +
+# protocol + sharding guards
+pytestmark = pytest.mark.slow
+
 from handel_tpu.ops import bn254_ref as bn
 from handel_tpu.ops.curve import BN254Curves
 from handel_tpu.ops.pairing import BN254Pairing

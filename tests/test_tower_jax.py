@@ -5,6 +5,11 @@ import random
 import jax
 import pytest
 
+# slow tier: XLA-compile-bound (tower arithmetic graphs) — runs in
+# test-slow/test-all (nightly/CI); the fast tier keeps the oracle +
+# protocol + sharding guards
+pytestmark = pytest.mark.slow
+
 from handel_tpu.ops import bn254_ref as bn
 from handel_tpu.ops.fp import Field
 from handel_tpu.ops.tower import Tower
@@ -77,7 +82,6 @@ def test_f12_frobenius(T):
     ]
 
 
-@pytest.mark.slow
 def test_f12_pow_u(T):
     xs = rand_f12s(1)
     ax = T.f12_pack(xs)
