@@ -8,7 +8,9 @@
 # (test_{fp,tower,curve,pairing,bls12_381}_jax, test_bn254_device,
 # test_bench) are slow-tier: nightly/CI coverage via test-slow/test-all.
 # The fast tier keeps the pure-Python curve oracles, the full protocol/
-# sim/transport planes, and the 8-device sharding guards (135 tests).
+# sim/transport planes, and the 8-device sharding guards — measured
+# post-split: 135 tests in 2:00 on the same core (warm cache), restoring
+# the minutes-fast contract.
 
 PY ?= python
 
