@@ -134,6 +134,117 @@ def write_json_atomic(path: str, obj: dict) -> None:
 PIPELINE_DEPTH = 8
 
 
+def host_pipeline_bench(
+    n_registry: int = 1024,
+    lanes: int = 256,
+    trials: int = 20,
+    seed: int = 77,
+) -> dict:
+    """Host half of the verify pipeline, measured on ANY backend (no device
+    launches): per-launch packing cost of the vectorized packer vs the old
+    per-candidate loop at `lanes` candidates, and the dedup hit rate of the
+    service cache on a Handel-shaped duplicate-delivery trace (every
+    winning aggregate re-delivered by several peers). Returns the metric
+    dict merged into the bench line: host_pack_ms, host_pack_loop_ms,
+    host_pack_speedup, dedup_hit_rate.
+    """
+    import asyncio
+    import threading  # noqa: F401  (parity with the service's test stubs)
+
+    import numpy as np
+
+    from handel_tpu import native as nat
+    from handel_tpu.core.bitset import BitSet
+    from handel_tpu.models.bn254 import BN254PublicKey, BN254Signature
+    from handel_tpu.models.bn254_jax import BN254Device
+    from handel_tpu.ops import bn254_ref as bn
+
+    rng = random.Random(seed)
+    sks = [rng.randrange(1, 1 << 20) for _ in range(n_registry)]
+    pks = [
+        BN254PublicKey(p) for p in nat.g2_mul_batch([bn.G2_GEN] * n_registry, sks)
+    ]
+    device = BN254Device(pks, batch_size=lanes)
+
+    # Handel-realistic requests: contiguous partitioner ranges, <=8 holes
+    sig = BN254Signature(bn.G1_GEN)
+    requests = []
+    for _ in range(lanes):
+        size = rng.choice([n_registry // 8, n_registry // 4, n_registry // 2])
+        lo = rng.randrange(0, n_registry - size)
+        max_holes = min(9, max(1, size - 2))
+        holes = set(
+            rng.sample(range(lo + 1, lo + size - 1), rng.randrange(0, max_holes))
+        )
+        bs = BitSet(n_registry)
+        for i in range(lo, lo + size):
+            if i not in holes:
+                bs.set(i, True)
+        requests.append((bs, sig))
+
+    def p50(pack):
+        ts = []
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            pack(requests)
+            ts.append((time.perf_counter() - t0) * 1000.0)
+        return float(np.percentile(ts, 50))
+
+    pack_vec_ms = p50(device._pack_requests)
+    pack_loop_ms = p50(device._pack_requests_loop)
+
+    # dedup hit rate over a multi-peer delivery trace: 32 distinct winning
+    # aggregates, each re-delivered by 8 peers, shuffled — the shape
+    # processing.go re-verifies in full and the cache short-circuits
+    class _StubDevice:
+        batch_size = lanes
+
+        def dispatch(self, msg, reqs):
+            return len(reqs)
+
+        def fetch(self, handle):
+            return [True] * handle
+
+    distinct, fanout = min(32, lanes), 8
+    deliveries = list(range(distinct)) * fanout
+    rng.shuffle(deliveries)
+
+    async def dedup_trace():
+        from handel_tpu.parallel.batch_verifier import BatchVerifierService
+
+        svc = BatchVerifierService(_StubDevice(), max_delay_ms=0.1)
+        for i in deliveries:
+            await svc.verify(b"bench", [], [requests[i]])
+        vals = svc.values()
+        svc.stop()
+        return vals
+
+    vals = asyncio.run(dedup_trace())
+    return {
+        "host_pack_ms": round(pack_vec_ms, 3),
+        "host_pack_loop_ms": round(pack_loop_ms, 3),
+        "host_pack_speedup": round(pack_loop_ms / pack_vec_ms, 2)
+        if pack_vec_ms > 0
+        else None,
+        "dedup_hit_rate": round(vals["dedupHitRate"], 4),
+    }
+
+
+def _host_metrics() -> dict:
+    """host_pipeline_bench behind the bench's degrade-don't-die contract
+    (+ a shape override for tests: HANDEL_TPU_BENCH_HOST_SHAPE =
+    'registry,lanes,trials')."""
+    shape = os.environ.get("HANDEL_TPU_BENCH_HOST_SHAPE")
+    try:
+        if shape:
+            n_registry, lanes, trials = (int(x) for x in shape.split(","))
+            return host_pipeline_bench(n_registry, lanes, trials)
+        return host_pipeline_bench()
+    except Exception as e:
+        print(f"bench: host pipeline bench failed: {e}", file=sys.stderr)
+        return {}
+
+
 def measure_pipelined(launch, block, trials: int, depth: int = PIPELINE_DEPTH):
     """Sustained per-launch latency, ms: dispatch `depth` launches
     back-to-back and block only on the last (the chip executes in order, so
@@ -461,6 +572,9 @@ def _measure() -> None:
             # measurement on the one-line contract
             line["forced_shape"] = True
             line["vs_baseline"] = None
+        # host half of the pipeline: packing + dedup metrics (host-side,
+        # backend-independent — measured in-process, no extra launches)
+        line.update(_host_metrics())
 
         def persist(extra_line: dict) -> None:
             # provenance so a later tunnel outage can't erase the capture
@@ -523,6 +637,7 @@ def _measure() -> None:
             "note": "CPU fallback smoke (16 keys); not comparable to the "
             "reference 4000-sig headline",
         }
+        line.update(_host_metrics())
         _emit(line)
 
 

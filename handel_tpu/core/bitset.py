@@ -123,6 +123,17 @@ class BitSet:
 
     # -- device views ------------------------------------------------------
 
+    def words(self) -> np.ndarray:
+        """The packed little-endian uint64 word array backing this bitset.
+
+        A VIEW, not a copy — callers must treat it as read-only. This is the
+        zero-copy handoff the vectorized launch packer consumes: a batch of
+        bitsets stacks to a (C, W) uint64 matrix and one `np.unpackbits`
+        yields every candidate's dense mask without per-bit Python
+        (models/bn254_jax.py `_pack_requests`). Also the cheap identity for
+        dedup keys: `words().tobytes()` hashes the exact bit content."""
+        return self._words
+
     def mask_bool(self, length: int | None = None) -> np.ndarray:
         """Dense bool mask (optionally zero-padded to `length`) for device kernels."""
         n = self._n if length is None else length

@@ -30,6 +30,7 @@ from handel_tpu.core.bitset import BitSet
 from handel_tpu.core.crypto import Constructor, PublicKey, Signature
 from handel_tpu.core.logging import DEFAULT_LOGGER, Logger
 from handel_tpu.core.partitioner import BinomialPartitioner, IncomingSig
+from handel_tpu.core.store import VerifiedAggCache
 
 
 class SigEvaluator(Protocol):
@@ -99,6 +100,7 @@ class BatchProcessing:
         batch_size: int = 16,
         verifier: AsyncVerifier | None = None,
         unsafe_sleep_ms: int = 0,
+        dedup_cache: VerifiedAggCache | None = None,
         logger: Logger = DEFAULT_LOGGER,
     ):
         self.part = part
@@ -113,6 +115,10 @@ class BatchProcessing:
         self.log = logger
         self.filter: Filter = IndividualSigFilter()
         self.max_retries = 3  # per-candidate verifier-error retry budget
+        # verified-aggregate dedup: Handel re-receives the same winning
+        # aggregate from several peers per level; each copy this node has
+        # already judged short-circuits here instead of burning a device lane
+        self.dedup = dedup_cache or VerifiedAggCache()
 
         # priority queue of (-score, seq, sig): scored once at enqueue, lazily
         # re-scored at dequeue (see _select_batch). `_todos` stays a plain
@@ -241,17 +247,47 @@ class BatchProcessing:
 
     async def _verify_and_publish(self, batch: list[IncomingSig]) -> None:
         start = time.perf_counter()
-        if self.unsafe_sleep_ms > 0:
+        # Dedup pass: a candidate whose exact content — (level, bitset words,
+        # signature bytes) — this node has already judged takes its remembered
+        # verdict; duplicates WITHIN the batch ride the first copy's lane.
+        # Only the remainder goes to the device.
+        oks: list[bool | None] = [None] * len(batch)
+        keys: list[tuple] = []
+        first_at: dict[tuple, int] = {}
+        to_verify: list[int] = []
+        for i, sp in enumerate(batch):
+            k = VerifiedAggCache.key(sp.level, sp.ms)
+            keys.append(k)
+            if k in first_at:
+                self.dedup.hits += 1  # in-batch duplicate: zero extra lanes
+                continue
+            cached = self.dedup.get(k)
+            if cached is not None:
+                oks[i] = cached
+            else:
+                first_at[k] = i
+                to_verify.append(i)
+
+        if self.unsafe_sleep_ms > 0 and to_verify:
             # test/simulation knob replacing verification with a sleep
-            # (config.go:61-65, UnsafeSleepTimeOnSigVerify)
-            await asyncio.sleep(self.unsafe_sleep_ms * len(batch) / 1000.0)
-            oks = [True] * len(batch)
-        else:
+            # (config.go:61-65, UnsafeSleepTimeOnSigVerify); dedup hits cost
+            # no simulated device time, same as on the real device
+            await asyncio.sleep(self.unsafe_sleep_ms * len(to_verify) / 1000.0)
+            for i in to_verify:
+                oks[i] = True
+        elif to_verify:
             try:
                 requests = [
-                    (self._global_bitset(sp), sp.ms.signature) for sp in batch
+                    (self._global_bitset(batch[i]), batch[i].ms.signature)
+                    for i in to_verify
                 ]
-                oks = await self.verifier(self.msg, self.pubkeys, requests)
+                verdicts = await self.verifier(self.msg, self.pubkeys, requests)
+                if len(verdicts) != len(to_verify):
+                    self.log.error(
+                        "verifier_contract",
+                        f"{len(verdicts)} verdicts for {len(to_verify)} requests",
+                    )
+                    verdicts = None
             except Exception as e:
                 # A transient verifier error (device hiccup, RPC failure) must
                 # not silently discard candidates: requeue the batch with a
@@ -260,18 +296,25 @@ class BatchProcessing:
                 # on, processing.go:282-284; the protocol's periodic resend is
                 # not guaranteed for individual sigs, hence the requeue.)
                 self.log.warn("verifier_error", e)
-                self._requeue(batch)
-                return
-            if len(oks) != len(batch):
-                self.log.error(
-                    "verifier_contract",
-                    f"{len(oks)} verdicts for {len(batch)} requests",
-                )
-                self._requeue(batch)
-                return
+                verdicts = None
+            if verdicts is None:
+                # requeue every unresolved candidate (the device subset AND
+                # its in-batch duplicates); cached verdicts still publish
+                self._requeue([sp for sp, ok in zip(batch, oks) if ok is None])
+            else:
+                for i, ok in zip(to_verify, verdicts):
+                    oks[i] = bool(ok)
+                    self.dedup.put(keys[i], bool(ok))
+        # resolve in-batch duplicates from their first copy's verdict (which
+        # stays None — and so requeued, above — if the verifier errored)
+        for i, k in enumerate(keys):
+            if oks[i] is None and first_at.get(k, i) != i:
+                oks[i] = oks[first_at[k]]
         self.sig_checking_time_ms += (time.perf_counter() - start) * 1000.0
 
         for sp, ok in zip(batch, oks):
+            if ok is None:
+                continue  # verifier error: already requeued above
             if ok:
                 self.on_verified(sp)
                 # the publish mutates the store, which can RAISE queued
@@ -323,6 +366,9 @@ class BatchProcessing:
             "sigCheckingTime": (
                 self.sig_checking_time_ms / checked if checked else 0.0
             ),
+            # dedup plane: sigCheckedCt counts SELECTED candidates; subtract
+            # dedupHits for actual device verifications
+            **self.dedup.values(),
         }
 
 

@@ -19,11 +19,79 @@ design (SURVEY.md §5.2).
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import Callable
 
 from handel_tpu.core.bitset import BitSet
 from handel_tpu.core.crypto import Constructor, MultiSignature
 from handel_tpu.core.partitioner import BinomialPartitioner, IncomingSig
+
+
+class VerifiedAggCache:
+    """Bounded LRU of aggregate-verification verdicts.
+
+    Handel's gossip pattern re-delivers the same winning aggregate from many
+    peers per level (the reference re-verifies every copy,
+    processing.go:258-287); each re-verification burns a device lane.  This
+    cache keys a candidate by its exact content — (level, bitset words,
+    signature bytes) — so a copy this node has already judged short-circuits
+    to the remembered verdict with zero device work.  Negative verdicts are
+    cached too: a known-bad aggregate re-sent by a byzantine peer costs
+    nothing after the first pairing check.
+
+    Used per-node by `BatchProcessing` (core/processing.py) and, keyed by
+    message instead of level, process-wide by `BatchVerifierService`
+    (parallel/batch_verifier.py) where co-located nodes dedup each other.
+    Bounded so a flood of distinct aggregates cannot grow host memory
+    unboundedly; LRU because Handel traffic is bursty per level — the
+    current level's winners stay hot, finished levels age out.
+
+    Single-threaded like the store itself (module docstring): every caller
+    runs on one asyncio loop, so no lock.
+    """
+
+    def __init__(self, capacity: int = 4096):
+        if capacity < 1:
+            raise ValueError("cache capacity must be >= 1")
+        self.capacity = capacity
+        self._map: OrderedDict[tuple, bool] = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def key(scope: int | bytes, ms: MultiSignature) -> tuple:
+        """Content identity of a candidate: scope (level or message),
+        exact bitset words, exact signature bytes."""
+        return (scope, ms.bitset.words().tobytes(), ms.signature.marshal())
+
+    def get(self, key: tuple) -> bool | None:
+        """Remembered verdict for `key`, or None; counts the hit/miss."""
+        verdict = self._map.get(key)
+        if verdict is None:
+            self.misses += 1
+            return None
+        self._map.move_to_end(key)
+        self.hits += 1
+        return verdict
+
+    def put(self, key: tuple, verdict: bool) -> None:
+        self._map[key] = verdict
+        self._map.move_to_end(key)
+        while len(self._map) > self.capacity:
+            self._map.popitem(last=False)
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+    def values(self) -> dict[str, float]:
+        """Reporter surface for the monitor plane (sim/monitor.py CounterIO)."""
+        total = self.hits + self.misses
+        return {
+            "dedupHits": float(self.hits),
+            "dedupMisses": float(self.misses),
+            "dedupHitRate": self.hits / total if total else 0.0,
+            "dedupSize": float(len(self._map)),
+        }
 
 
 class SignatureStore:

@@ -46,9 +46,14 @@ class BLS12381JaxConstructor(BLS12381Constructor, BN254JaxConstructor):
         batch_size: int = 16,
         curves: BLS12Curves | None = None,
         mesh_devices: int = 1,
+        warmup: bool = True,
     ):
         BN254JaxConstructor.__init__(
-            self, batch_size=batch_size, curves=curves, mesh_devices=mesh_devices
+            self,
+            batch_size=batch_size,
+            curves=curves,
+            mesh_devices=mesh_devices,
+            warmup=warmup,
         )
 
 
@@ -56,7 +61,12 @@ class BLS12381JaxScheme(BLS12381Scheme):
     """Keygen facade for harness/simulation use: the host scheme's keygen and
     wire formats with the device-verification constructor swapped in."""
 
-    def __init__(self, batch_size: int = 16, mesh_devices: int = 1):
+    def __init__(
+        self,
+        batch_size: int = 16,
+        mesh_devices: int = 1,
+        warmup: bool = True,
+    ):
         self.constructor = BLS12381JaxConstructor(
-            batch_size=batch_size, mesh_devices=mesh_devices
+            batch_size=batch_size, mesh_devices=mesh_devices, warmup=warmup
         )

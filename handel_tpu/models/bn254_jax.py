@@ -22,6 +22,8 @@ across calls.
 from __future__ import annotations
 
 import asyncio
+import time
+from collections import namedtuple
 from functools import partial
 from typing import Sequence
 
@@ -40,6 +42,27 @@ from handel_tpu.models.bn254 import (
 from handel_tpu.ops import bn254_ref as bn
 from handel_tpu.ops.curve import BN254Curves
 from handel_tpu.ops.pairing import BN254Pairing
+
+# Device-input arrays for one launch, as the packer hands them to dispatch:
+# kind selects the kernel family ("range" = prefix-table path with a miss_k-
+# wide hole patch, "dense" = masked registry sum); sig_* are packed limb
+# arrays; valid masks the real lanes. Array fields not used by `kind` are
+# None. Plans from `_pack_requests` view REUSED staging buffers and are
+# invalidated by the next call; `_pack_requests_loop` plans own their arrays.
+LaunchPlan = namedtuple(
+    "LaunchPlan",
+    "kind miss_k lo hi miss_idx miss_ok mask sig_x sig_y valid",
+)
+
+
+class _WarmupSig:
+    """Minimal signature stand-in for warmup launches (only `.point` is
+    read by the packer); verdicts are discarded, so no real signing."""
+
+    __slots__ = ("point",)
+
+    def __init__(self, point):
+        self.point = point
 
 
 class BN254Device:
@@ -116,6 +139,27 @@ class BN254Device:
         self._prefix_cache = None
         self._kernel = jax.jit(self._verify_batch)
         self._range_kernels: dict[int, callable] = {}
+        # pre-allocated, reused staging buffers for the vectorized launch
+        # packer (_pack_requests): a launch's host cost is O(batch) numpy
+        # ops on these, never O(batch) Python iterations. Reuse is safe
+        # because _dispatch_one snapshots each staged array at the device
+        # boundary (jax's CPU client aliases some dtypes instead of
+        # copying — see the `snap` note there); a single dispatcher
+        # (BatchVerifierService's collector, or a caller's own loop) is
+        # assumed — same contract as the kernels themselves.
+        C = batch_size
+        self._stage_words = np.zeros((C, (self.n + 63) // 64), np.uint64)
+        self._stage_valid = np.zeros((C,), bool)
+        self._stage_lo = np.zeros((C,), np.int32)
+        self._stage_hi = np.zeros((C,), np.int32)
+        self._stage_miss = np.zeros((self.MISS_CAP, C), np.int64)
+        self._stage_miss_ok = np.zeros((self.MISS_CAP, C), bool)
+        self._stage_cols = np.arange(self.n)
+        self._stage_mask = None  # dense-fallback (n, C) mask, built lazily
+        # host-packing counters (bench.py host_pack_ms; monitor plane via
+        # BatchVerifierService.values)
+        self.host_pack_ms = 0.0
+        self.host_pack_launches = 0
 
     @property
     def _prefix(self):
@@ -306,6 +350,12 @@ class BN254Device:
             self._h_cache[msg] = cached
         return cached
 
+    # dispatch-ahead bound for batch_verify: at most this many chunks'
+    # device buffers in flight ahead of the fetch cursor (mirrors the
+    # service's max_inflight; an unbounded window kept EVERY chunk's
+    # uploads resident on device simultaneously — ADVICE r5 #3)
+    MAX_DISPATCH_AHEAD = 4
+
     def batch_verify(
         self,
         msg: bytes,
@@ -314,19 +364,22 @@ class BN254Device:
         """Verify up to batch_size (global bitset, aggregate sig) candidates
         in one device launch; longer request lists run in several launches.
 
-        Launches are PIPELINED: every chunk is dispatched (enqueued on the
-        device — jax dispatch is async) before the first verdict array is
+        Launches are PIPELINED: a chunk is dispatched (enqueued on the
+        device — jax dispatch is async) before earlier verdict arrays are
         pulled back to the host, so the per-dispatch round trip (~66 ms on
         this environment's tunneled chip, results/verify_profile.json)
         overlaps chip compute of the launches behind it instead of
-        serializing with it. The reference's loop verifies one signature at
-        a time on the caller's goroutine (processing.go:258-287)."""
-        handles = [
-            self.dispatch(msg, requests[i : i + self.batch_size])
-            for i in range(0, len(requests), self.batch_size)
-        ]
+        serializing with it — but at most MAX_DISPATCH_AHEAD chunks ahead
+        of the fetch cursor, bounding device-resident input buffers. The
+        reference's loop verifies one signature at a time on the caller's
+        goroutine (processing.go:258-287)."""
         out: list[bool] = []
-        for h in handles:
+        window: list = []
+        for i in range(0, len(requests), self.batch_size):
+            if len(window) >= self.MAX_DISPATCH_AHEAD:
+                out.extend(self.fetch(window.pop(0)))
+            window.append(self.dispatch(msg, requests[i : i + self.batch_size]))
+        for h in window:
             out.extend(self.fetch(h))
         return out
 
@@ -345,11 +398,142 @@ class BN254Device:
         verdicts, k = handle
         return [bool(v) for v in np.asarray(verdicts)[:k]]
 
+    def warmup(self) -> int:
+        """Compile every kernel a verification round can reach, up front.
+
+        Dispatches one synthetic launch per reachable input class — range
+        kernel at miss_k=8, range kernel at miss_k=64, dense fallback — so
+        no round ever stalls on a mid-run XLA compile (before this, the
+        first candidate in a new hole-count class blocked its whole round).
+        Classes a registry of this size cannot produce are skipped: the
+        64-hole class needs an 11-wide hull, the dense fallback a
+        (MISS_CAP+3)-wide one. Returns the number of launches issued.
+        Called at scheme construction (BN254JaxConstructor.prepare).
+        """
+        shapes: list[list[int]] = [
+            # zero holes -> miss_k=8 class (also builds the prefix table)
+            list(range(min(self.n, 2)))
+        ]
+        if self.n >= 11:
+            # hull [0, 11) with 9 holes -> miss_k=64 class
+            shapes.append([0, 10])
+        if self.n >= self.MISS_CAP + 3:
+            # MISS_CAP+1 holes -> dense masked-sum fallback
+            shapes.append([0, self.MISS_CAP + 2])
+        sig = _WarmupSig(self.ref.G1_GEN)
+        launches = 0
+        for signers in shapes:
+            bs = BitSet(self.n)
+            for i in signers:
+                bs.set(i, True)
+            self.fetch(self.dispatch(b"bn254-device-warmup", [(bs, sig)]))
+            launches += 1
+        # warmup launches must not skew the host-packing telemetry
+        self.host_pack_ms = 0.0
+        self.host_pack_launches = 0
+        return launches
+
     # missing-signer patch width cap: candidates whose range hull has more
     # holes than this fall back to the dense masked-sum kernel
     MISS_CAP = 64
 
-    def _dispatch_one(self, msg, requests):
+    def _pack_requests(self, requests) -> "LaunchPlan":
+        """Vectorized launch packing: requests -> device-input arrays.
+
+        Bitsets hand over their packed uint64 words (BitSet.words, zero
+        copy); one `np.unpackbits` yields the whole batch's dense bit
+        matrix, range bounds come from two argmax scans, and the missing-
+        signer patch (the holes in each candidate's range hull) is extracted
+        with a single `np.nonzero` scan over the batch — replacing the old
+        per-candidate Python loop of `np.fromiter`/`np.setdiff1d`. Staging
+        buffers are owned by the device and REUSED: the returned plan's
+        arrays are views that the next _pack_requests call invalidates.
+
+        Bit-identical to `_pack_requests_loop` (property-tested), which
+        keeps the old per-candidate construction as the readable oracle.
+        """
+        C = self.batch_size
+        n = self.n
+        k = len(requests)
+        words = self._stage_words
+        words[:] = 0
+        valid = self._stage_valid
+        valid[:] = False
+        sig_pts: list = []
+        for j, (bs, sig) in enumerate(requests):
+            if len(bs) != n:
+                raise ValueError("bitset length != registry size")
+            words[j, :] = bs.words()
+            sig_pts.append(getattr(sig, "point", None))
+
+        bits = np.unpackbits(
+            words.view(np.uint8), axis=1, count=n, bitorder="little"
+        ).view(np.bool_)  # (C, n) — every candidate's dense mask in one op
+        card = bits.sum(axis=1, dtype=np.int64)
+        if k:
+            valid[:k] = (card[:k] > 0) & np.fromiter(
+                (p is not None for p in sig_pts), bool, count=k
+            )
+        vbits = bits & valid[:, None]  # invalid lanes contribute nothing
+
+        lo, hi = self._stage_lo, self._stage_hi
+        nonempty = vbits.any(axis=1)
+        lo[:] = np.where(nonempty, vbits.argmax(axis=1), 0)
+        hi[:] = np.where(
+            nonempty, n - vbits[:, ::-1].argmax(axis=1), 0
+        )  # one past the last set bit
+        holes = (hi.astype(np.int64) - lo) - np.where(valid, card, 0)
+        max_holes = int(holes.max())
+
+        # lanes with a point but an empty bitset stay masked placeholders,
+        # like the old loop (valid gating covers both cases)
+        pts = [
+            pt if valid[j] else self.ref.G1_GEN
+            for j, pt in enumerate(sig_pts)
+        ]
+        pts += [self.ref.G1_GEN] * (C - k)  # pad lanes
+        F = self.curves.F
+        sig_x = F.pack_batch([p[0] for p in pts])
+        sig_y = F.pack_batch([p[1] for p in pts])
+
+        if max_holes > self.MISS_CAP:
+            if self._stage_mask is None:
+                self._stage_mask = np.zeros((n, C), dtype=bool)
+            mask = self._stage_mask
+            mask[:] = vbits.T
+            return LaunchPlan(
+                "dense", 0, None, None, None, None, mask, sig_x, sig_y, valid
+            )
+
+        # quantize the patch width to two classes so at most two range
+        # kernels ever compile (each variant jit-compiles the whole
+        # pairing graph; a fresh hole-count class mid-run would
+        # otherwise stall that verification round on XLA)
+        miss_k = 8 if max_holes <= 8 else self.MISS_CAP
+        miss_idx = self._stage_miss[:miss_k]
+        miss_ok = self._stage_miss_ok[:miss_k]
+        miss_idx[:] = 0
+        miss_ok[:] = False
+        cols = self._stage_cols
+        missing = (
+            (cols >= lo[:, None]) & (cols < hi[:, None]) & ~bits
+        )  # (C, n): holes inside each candidate's hull
+        rj, cj = np.nonzero(missing)  # row-major: per-candidate, ascending
+        if rj.size:
+            counts = missing.sum(axis=1)
+            offs = np.concatenate(([0], np.cumsum(counts)[:-1]))
+            pos = np.arange(rj.size) - offs[rj]
+            miss_idx[pos, rj] = cj
+            miss_ok[pos, rj] = True
+        return LaunchPlan(
+            "range", miss_k, lo, hi, miss_idx, miss_ok, None, sig_x, sig_y,
+            valid,
+        )
+
+    def _pack_requests_loop(self, requests) -> "LaunchPlan":
+        """The pre-vectorization per-candidate packer, kept as the oracle
+        for `_pack_requests` equivalence tests and the bench.py host_pack_ms
+        before/after comparison. Allocates fresh arrays (no staging)."""
         C = self.batch_size
         F = self.curves.F
         sig_pts = []
@@ -369,75 +553,94 @@ class BN254Device:
         sig_pts += [self.ref.G1_GEN] * (C - len(sig_pts))  # pad lanes
         sig_x = F.pack([p[0] for p in sig_pts])
         sig_y = F.pack([p[1] for p in sig_pts])
-        h_x, h_y = self._h_point(msg)
 
-        # Handel candidates are partitioner ID ranges with few holes: try the
-        # prefix-table fast path, fall back to the dense kernel otherwise
         holes = [
             int(idx[-1] - idx[0] + 1 - idx.size) if v and idx.size else 0
             for idx, v in zip(sets, valid)
         ]
-        if max(holes, default=0) <= self.MISS_CAP:
-            lo = np.zeros((C,), np.int32)
-            hi = np.zeros((C,), np.int32)
-            # quantize the patch width to two classes so at most two range
-            # kernels ever compile (each variant jit-compiles the whole
-            # pairing graph; a fresh hole-count class mid-run would
-            # otherwise stall that verification round on XLA)
-            miss_k = 8 if max(holes, default=0) <= 8 else self.MISS_CAP
-            miss_idx = np.zeros((miss_k, C), np.int64)
-            miss_ok = np.zeros((miss_k, C), dtype=bool)
-            for j, idx in enumerate(sets):
-                if not valid[j] or not idx.size:
-                    continue
-                lo[j] = idx[0]
-                hi[j] = idx[-1] + 1
-                missing = np.setdiff1d(
-                    np.arange(idx[0], idx[-1] + 1), idx, assume_unique=True
-                )
-                miss_idx[: missing.size, j] = missing
-                miss_ok[: missing.size, j] = True
-            range_args = (
-                jnp.asarray(lo),
-                jnp.asarray(hi),
-                jnp.asarray(miss_idx.reshape(-1)),
-                jnp.asarray(miss_ok.reshape(-1)),
-            )
-            if self.mesh is not None:
-                agg = self._range_agg_kernel(miss_k)(*range_args)
-                verdicts = self._sharded_tail(
-                    agg, sig_x, sig_y, h_x, h_y, jnp.asarray(valid)
-                )
-            else:
-                verdicts = self._range_kernel(miss_k)(
-                    *range_args, sig_x, sig_y, h_x, h_y, jnp.asarray(valid)
-                )
-        else:
+        if max(holes, default=0) > self.MISS_CAP:
             mask = np.zeros((self.n, C), dtype=bool)
             for j, idx in enumerate(sets):
                 if valid[j] and idx.size:
                     mask[idx, j] = True
+            return LaunchPlan(
+                "dense", 0, None, None, None, None, mask, sig_x, sig_y, valid
+            )
+        lo = np.zeros((C,), np.int32)
+        hi = np.zeros((C,), np.int32)
+        miss_k = 8 if max(holes, default=0) <= 8 else self.MISS_CAP
+        miss_idx = np.zeros((miss_k, C), np.int64)
+        miss_ok = np.zeros((miss_k, C), dtype=bool)
+        for j, idx in enumerate(sets):
+            if not valid[j] or not idx.size:
+                continue
+            lo[j] = idx[0]
+            hi[j] = idx[-1] + 1
+            missing = np.setdiff1d(
+                np.arange(idx[0], idx[-1] + 1), idx, assume_unique=True
+            )
+            miss_idx[: missing.size, j] = missing
+            miss_ok[: missing.size, j] = True
+        return LaunchPlan(
+            "range", miss_k, lo, hi, miss_idx, miss_ok, None, sig_x, sig_y,
+            valid,
+        )
+
+    def _dispatch_one(self, msg, requests):
+        t0 = time.perf_counter()
+        plan = self._pack_requests(requests)
+        self.host_pack_ms += (time.perf_counter() - t0) * 1000.0
+        self.host_pack_launches += 1
+        h_x, h_y = self._h_point(msg)
+        # staging arrays MUST be copied at the device boundary: jax's CPU
+        # client zero-copy-aliases some numpy dtypes (measured: bool) into
+        # its buffers, and with pipelined dispatch the next launch's pack
+        # would mutate a still-in-flight launch's inputs. One memcpy per
+        # array — the vectorized construction is the win, not the handoff.
+        snap = lambda a: jnp.asarray(a.copy())
+        sig_x, sig_y, valid = plan.sig_x, plan.sig_y, snap(plan.valid)
+
+        # Handel candidates are partitioner ID ranges with few holes: the
+        # prefix-table fast path; the dense kernel is the arbitrary-set
+        # fallback (plan.kind decides, same classes as always)
+        if plan.kind == "range":
+            range_args = (
+                snap(plan.lo),
+                snap(plan.hi),
+                snap(plan.miss_idx.reshape(-1)),
+                snap(plan.miss_ok.reshape(-1)),
+            )
+            if self.mesh is not None:
+                agg = self._range_agg_kernel(plan.miss_k)(*range_args)
+                verdicts = self._sharded_tail(
+                    agg, sig_x, sig_y, h_x, h_y, valid
+                )
+            else:
+                verdicts = self._range_kernel(plan.miss_k)(
+                    *range_args, sig_x, sig_y, h_x, h_y, valid
+                )
+        else:
             if self.mesh is not None:
                 agg = self._sharded_sum(
                     self._reg_x[0],
                     self._reg_x[1],
                     self._reg_y[0],
                     self._reg_y[1],
-                    jnp.asarray(mask),
+                    snap(plan.mask),
                 )
                 verdicts = self._sharded_tail(
-                    agg, sig_x, sig_y, h_x, h_y, jnp.asarray(valid)
+                    agg, sig_x, sig_y, h_x, h_y, valid
                 )
             else:
                 verdicts = self._kernel(
                     self._reg_x,
                     self._reg_y,
-                    jnp.asarray(mask.reshape(-1)),
+                    snap(plan.mask.reshape(-1)),
                     sig_x,
                     sig_y,
                     h_x,
                     h_y,
-                    jnp.asarray(valid),
+                    valid,
                 )
         return verdicts
 
@@ -457,10 +660,12 @@ class BN254JaxConstructor(BN254Constructor):
         batch_size: int = 16,
         curves: BN254Curves | None = None,
         mesh_devices: int = 1,
+        warmup: bool = True,
     ):
         self.batch_size = batch_size
         self.mesh_devices = mesh_devices
         self.curves = curves or self.Device.Curves()
+        self.warmup = warmup
         self._device: BN254Device | None = None
         self._device_for: int | None = None
 
@@ -471,6 +676,10 @@ class BN254JaxConstructor(BN254Constructor):
             curves=self.curves,
             mesh_devices=self.mesh_devices,
         )
+        if self.warmup:
+            # compile all reachable kernels NOW, at scheme construction, so
+            # no verification round stalls on a mid-run XLA compile
+            self._device.warmup()
         # hold the list itself: the id() cache key below is only valid while
         # the original object is alive (id reuse after GC would alias a new
         # registry to the cached one)
@@ -503,9 +712,14 @@ class BN254JaxScheme(BN254Scheme):
     wire formats (incl. unmarshal_public/unmarshal_secret for the registry
     CSV) with the device-verification constructor swapped in."""
 
-    def __init__(self, batch_size: int = 16, mesh_devices: int = 1):
+    def __init__(
+        self,
+        batch_size: int = 16,
+        mesh_devices: int = 1,
+        warmup: bool = True,
+    ):
         self.constructor = BN254JaxConstructor(
-            batch_size=batch_size, mesh_devices=mesh_devices
+            batch_size=batch_size, mesh_devices=mesh_devices, warmup=warmup
         )
 
 

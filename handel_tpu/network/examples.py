@@ -75,13 +75,17 @@ async def run_demo(n_peers: int = 3, kind: str = "udp", timeout: float = 10.0):
             nets.append(net)
             collectors.append(col)
         # datagrams can race the receiving endpoints; resend until heard
-        async with asyncio.timeout(timeout):
+        # (wait_for, not asyncio.timeout: the latter is 3.11-only and this
+        # module is the last thing keeping the package off 3.10)
+        async def resend_until_heard():
             while not all(c.done.is_set() for c in collectors):
                 for i, (net, col) in enumerate(zip(nets, collectors)):
                     if not col.done.is_set():
                         others = [p for j, p in enumerate(peers) if j != i]
                         net.send(others, Packet(origin=i, level=1, multisig=b"hello"))
                 await asyncio.sleep(0.05)
+
+        await asyncio.wait_for(resend_until_heard(), timeout)
     finally:
         for net in nets:
             net.stop()

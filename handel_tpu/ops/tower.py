@@ -422,7 +422,14 @@ class Tower:
     def f12_frobenius2(self, a):
         return self.f12_frobenius(self.f12_frobenius(a))
 
-    def f12_pow_const(self, a, e: int, cyclo: bool = False, unroll: bool = False):
+    def f12_pow_const(
+        self,
+        a,
+        e: int,
+        cyclo: bool = False,
+        unroll: bool = False,
+        window: int | None = None,
+    ):
         """a^e for a fixed public exponent. cyclo=True uses the 3x-cheaper
         cyclotomic squaring — only valid when a lives in the cyclotomic
         subgroup (final exp).
@@ -436,7 +443,11 @@ class Tower:
             production caller opts in — this environment's compilers cannot
             absorb pairing-sized unrolled graphs (BN254Pairing.__init__
             note) — but the lowering is kept, tested at small exponents, for
-            co-located deployments whose compiler can."""
+            co-located deployments whose compiler can.
+
+        `window` pins the scan's digit width (1 = plain bit scan, 4 = the
+        accelerator table+gather form); None defers to default_pow_window so
+        tests can oracle-check both lowerings on any backend."""
         import jax
 
         from handel_tpu.ops.fp import default_pow_window, windowed_pow
@@ -462,7 +473,7 @@ class Tower:
         return windowed_pow(
             a,
             e,
-            default_pow_window(),
+            default_pow_window() if window is None else window,
             mul=self.f12_mul,
             sqr=sqr,
             stack=lambda t: jax.tree_util.tree_map(

@@ -16,6 +16,7 @@ from functools import partial
 from typing import Sequence
 
 from handel_tpu.core.bitset import BitSet
+from handel_tpu.core.store import VerifiedAggCache
 from handel_tpu.models.bn254_jax import BN254Device
 
 
@@ -25,6 +26,13 @@ class BatchVerifierService:
     Wire into every node's Config.verifier via `.verifier`. Requests are
     answered with per-candidate verdicts; the collector waits up to
     `max_delay_ms` to fill a batch (latency/occupancy tradeoff knob).
+
+    Process-wide dedup: co-located nodes all receive (and would all verify)
+    the same winning aggregate per level. Requests are keyed by exact
+    content — (msg, bitset words, signature bytes) — against a shared
+    `VerifiedAggCache`, so a candidate ANY co-located node already verified
+    resolves instantly, and concurrent duplicates coalesce onto the one
+    in-flight copy's lane instead of each taking their own.
     """
 
     def __init__(
@@ -32,6 +40,7 @@ class BatchVerifierService:
         device: BN254Device,
         max_delay_ms: float = 2.0,
         max_inflight: int = 2,
+        dedup_cache: VerifiedAggCache | None = None,
     ):
         self.device = device
         self.max_delay = max_delay_ms / 1000.0
@@ -41,6 +50,15 @@ class BatchVerifierService:
         self._task: asyncio.Task | None = None
         self._fetch_task: asyncio.Task | None = None
         self._fetch_q: asyncio.Queue | None = None
+        # batches held by a pipeline stage OUTSIDE _pending/_fetch_q — the
+        # collector's dispatch-in-progress and the fetcher's fetch-in-progress
+        # — so stop() can fail their waiters too (a cancelled stage would
+        # otherwise strand them awaiting forever; ADVICE r5 #1)
+        self._collecting: list | None = None
+        self._fetching: list | None = None
+        # verified-aggregate dedup (shared across every node on this service)
+        self.cache = dedup_cache or VerifiedAggCache(capacity=8192)
+        self._inflight: dict[tuple, asyncio.Future] = {}
         # counters for the monitor plane
         self.launches = 0
         self.candidates = 0
@@ -59,8 +77,11 @@ class BatchVerifierService:
 
     def stop(self) -> None:
         """Cancel both pipeline stages and FAIL any unanswered waiters —
-        dropping them would leave callers awaiting forever. Resetting
-        _task lets a later verify() restart the service."""
+        dropping them would leave callers awaiting forever. That includes
+        the batch each stage holds OUTSIDE _pending/_fetch_q while it works
+        (dispatch or fetch in flight): cancelling the stage strands those
+        futures unless they are failed here. Resetting _task lets a later
+        verify() restart the service."""
         if self._task:
             self._task.cancel()
             self._task = None
@@ -78,10 +99,18 @@ class BatchVerifierService:
                     if not fut.done():
                         fut.set_exception(err)
             self._fetch_q = None
+        for stage in (self._collecting, self._fetching):
+            for _, _, fut in stage or ():
+                if not fut.done():
+                    fut.set_exception(err)
+        self._collecting = self._fetching = None
         for _, _, _, fut in self._pending:
             if not fut.done():
                 fut.set_exception(err)
         self._pending.clear()
+        # coalesced duplicates chained onto a failed primary are resolved by
+        # their done-callbacks when the loop next runs; nothing to do here
+        self._inflight.clear()
 
     async def verify(self, msg, pubkeys, requests) -> list[bool]:
         """AsyncVerifier-compatible entry (core/processing.py)."""
@@ -90,11 +119,51 @@ class BatchVerifierService:
         loop = asyncio.get_running_loop()
         futs = []
         for bs, sig in requests:
+            key = (msg, bs.words().tobytes(), sig.marshal())
+            cached = self.cache.get(key)
+            if cached is not None:
+                # some co-located node already verified this exact aggregate
+                fut = loop.create_future()
+                fut.set_result(cached)
+                futs.append(fut)
+                continue
+            primary = self._inflight.get(key)
+            if primary is not None and not primary.done():
+                # identical candidate already in flight: ride its lane. A
+                # dedup hit for lane accounting — undo the get()'s miss count
+                self.cache.misses -= 1
+                self.cache.hits += 1
+                fut = loop.create_future()
+                primary.add_done_callback(partial(self._chain, fut))
+                futs.append(fut)
+                continue
             fut = loop.create_future()
+            self._inflight[key] = fut
+            fut.add_done_callback(partial(self._uninflight, key))
             self._pending.append((msg, bs, sig, fut))
             futs.append(fut)
         self._kick.set()
         return list(await asyncio.gather(*futs))
+
+    @staticmethod
+    def _chain(fut: asyncio.Future, primary: asyncio.Future) -> None:
+        """Copy a resolved primary's outcome onto a coalesced duplicate."""
+        if fut.done():
+            return
+        if primary.cancelled():
+            fut.cancel()
+        elif primary.exception() is not None:
+            fut.set_exception(primary.exception())
+        else:
+            fut.set_result(primary.result())
+
+    def _uninflight(self, key: tuple, fut: asyncio.Future) -> None:
+        """Primary resolved: drop the in-flight marker and remember the
+        verdict so later copies of this aggregate never reach the device."""
+        if self._inflight.get(key) is fut:
+            del self._inflight[key]
+        if not fut.cancelled() and fut.exception() is None:
+            self.cache.put(key, bool(fut.result()))
 
     @property
     def verifier(self):
@@ -112,6 +181,10 @@ class BatchVerifierService:
             self._pending = self._pending[self.device.batch_size :]
             if not batch:
                 continue
+            # from here until every group is handed to _fetch_q the batch
+            # lives in neither _pending nor the queue: track it on self so
+            # stop() can fail these futures if this task is cancelled
+            self._collecting = [(bs, sig, fut) for _, bs, sig, fut in batch]
             # group by message (one launch per distinct msg in the batch;
             # a simulation run shares a single msg, so this is one launch)
             by_msg: dict[bytes, list[tuple[BitSet, object, asyncio.Future]]] = {}
@@ -127,6 +200,8 @@ class BatchVerifierService:
                     handle = await loop.run_in_executor(
                         None, partial(self.device.dispatch, msg, reqs)
                     )
+                except asyncio.CancelledError:
+                    raise  # stop() fails the futures via _collecting
                 except Exception as e:
                     for _, _, fut in items:
                         if not fut.done():
@@ -135,6 +210,7 @@ class BatchVerifierService:
                             )
                     continue
                 await self._fetch_q.put((handle, items))
+            self._collecting = None
 
     async def _fetcher(self) -> None:
         """Second pipeline stage: pull verdicts for dispatched launches, in
@@ -142,20 +218,27 @@ class BatchVerifierService:
         loop = asyncio.get_running_loop()
         while True:
             handle, items = await self._fetch_q.get()
+            # outside _fetch_q until resolved: visible to stop() (see
+            # _collector's mirror note)
+            self._fetching = items
             try:
                 verdicts = await loop.run_in_executor(
                     None, partial(self.device.fetch, handle)
                 )
+            except asyncio.CancelledError:
+                raise  # stop() fails the futures via _fetching
             except Exception as e:
                 for _, _, fut in items:
                     if not fut.done():
                         fut.set_exception(RuntimeError(f"batch verifier: {e}"))
+                self._fetching = None
                 continue
             self.launches += 1
             self.candidates += len(items)
             for (_, _, fut), ok in zip(items, verdicts):
                 if not fut.done():
                     fut.set_result(ok)
+            self._fetching = None
 
     def values(self) -> dict[str, float]:
         return {
@@ -166,4 +249,12 @@ class BatchVerifierService:
                 if self.launches
                 else 0.0
             ),
+            # host cost of building device inputs (vectorized packer,
+            # models/bn254_jax.py); 0 for device stubs without the counter
+            "hostPackMs": float(getattr(self.device, "host_pack_ms", 0.0)),
+            "hostPackLaunches": float(
+                getattr(self.device, "host_pack_launches", 0)
+            ),
+            # process-wide dedup plane (monitor keys: verifier_dedup*)
+            **self.cache.values(),
         }

@@ -24,7 +24,21 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+
+try:
+    from jax import shard_map
+except ImportError:  # pre-0.6 jax: experimental home, check_rep spelling
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _exp_shard_map(
+            f,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_rep=check_vma,
+        )
+
 from jax.sharding import Mesh, PartitionSpec as P
 
 from handel_tpu.ops.curve import BN254Curves

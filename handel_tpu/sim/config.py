@@ -14,7 +14,12 @@ launch width), `shared_verifier` (fuse co-located nodes' batches).
 from __future__ import annotations
 
 import random
-import tomllib
+
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python < 3.11: the tomli backport is the
+    import tomli as tomllib  # same module under its pre-stdlib name
+
 from dataclasses import dataclass, field
 
 from handel_tpu.core.config import Config

@@ -69,7 +69,15 @@ class TimeMeasure:
 
 class CounterIO:
     """Delta-of-Values() measure (measure.go CounterMeasure): snapshot a
-    reporter's counters at construction, record the difference."""
+    reporter's counters at construction, record the difference.
+
+    Keys ending in a GAUGE_SUFFIX are point-in-time ratios or levels (hit
+    rates, launch occupancy, cache sizes — e.g. the dedup plane's
+    `dedupHitRate`/`dedupSize`, core/store.py VerifiedAggCache.values):
+    `now - base` is meaningless for a ratio whenever the construction-time
+    snapshot is nonzero, so those are recorded as-is."""
+
+    GAUGE_SUFFIXES = ("Rate", "Occupancy", "Size")
 
     def __init__(self, sink: Sink, name: str, reporter):
         self.sink = sink
@@ -81,7 +89,14 @@ class CounterIO:
         now = self.reporter.values()
         self.sink.record(
             self.name,
-            {k: now[k] - self._base.get(k, 0.0) for k in now},
+            {
+                k: (
+                    v
+                    if k.endswith(self.GAUGE_SUFFIXES)
+                    else v - self._base.get(k, 0.0)
+                )
+                for k, v in now.items()
+            },
         )
 
 

@@ -28,6 +28,9 @@ def _run_bench(tmp_path, extra_env):
         HANDEL_TPU_BENCH_FP_ARTIFACT=str(tmp_path / "fp.json"),
         HANDEL_TPU_BENCH_FP_BATCH=str(1 << 10),
         HANDEL_TPU_MEASURE_BUDGET_S="1500",
+        # tiny host-pipeline shape: the packing/dedup metrics plumbing is
+        # exercised without the full 1024-key keygen per bench subprocess
+        HANDEL_TPU_BENCH_HOST_SHAPE="64,8,3",
         **extra_env,
     )
     r = subprocess.run(
@@ -59,6 +62,11 @@ def test_accel_measurement_path_persists_artifact(tmp_path):
     assert line["vs_baseline"] is None
     assert line["forced_shape"] is True
     assert line["backend"] == "cpu"
+    # host half of the pipeline rides the same line: packing p50 for the
+    # vectorized packer and the old loop, and the dedup-trace hit rate
+    assert line["host_pack_ms"] > 0
+    assert line["host_pack_loop_ms"] > 0
+    assert 0.0 <= line["dedup_hit_rate"] <= 1.0
 
     art = json.load(open(tmp_path / "bench_tpu.json"))
     assert art["backend"] == "cpu"  # provenance is honest about the force

@@ -1,0 +1,353 @@
+"""Verified-aggregate dedup: duplicate aggregates must cost zero device lanes.
+
+Handel's gossip pattern delivers the same winning aggregate from several
+peers per level; before the dedup cache every copy burned a device lane.
+Covered here: the cache itself (LRU bound, verdict memory, counters), the
+per-node pipeline (`BatchProcessing`: in-batch duplicates share one lane,
+re-received aggregates short-circuit entirely), and the process-wide service
+plane (`BatchVerifierService`: cross-node dedup, in-flight coalescing, and
+the stop()-mid-launch regression from ADVICE r5 #1).
+
+Fast tier: fake crypto + device stubs, nothing compiles.
+"""
+
+import asyncio
+import threading
+
+import pytest
+
+from handel_tpu.core.bitset import BitSet
+from handel_tpu.core.crypto import MultiSignature
+from handel_tpu.core.identity import ArrayRegistry, Identity
+from handel_tpu.core.partitioner import BinomialPartitioner, IncomingSig
+from handel_tpu.core.processing import BatchProcessing
+from handel_tpu.core.store import VerifiedAggCache
+from handel_tpu.models.fake import FakeConstructor, FakePublic, FakeSignature
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# -- the cache itself --------------------------------------------------------
+
+
+def test_cache_remembers_both_verdicts_and_counts():
+    cache = VerifiedAggCache(capacity=8)
+    bs = BitSet(4)
+    bs.set(1, True)
+    good = VerifiedAggCache.key(2, MultiSignature(bs, FakeSignature(True)))
+    bad = VerifiedAggCache.key(2, MultiSignature(bs, FakeSignature(False)))
+    assert good != bad  # signature bytes are part of the identity
+    assert cache.get(good) is None
+    cache.put(good, True)
+    cache.put(bad, False)
+    assert cache.get(good) is True
+    assert cache.get(bad) is False  # negative verdicts cached too
+    assert (cache.hits, cache.misses) == (2, 1)
+    vals = cache.values()
+    assert vals["dedupHits"] == 2.0 and vals["dedupMisses"] == 1.0
+    assert vals["dedupHitRate"] == pytest.approx(2 / 3)
+
+
+def test_cache_lru_bound_evicts_oldest():
+    cache = VerifiedAggCache(capacity=3)
+    for i in range(5):
+        cache.put((i,), True)
+    assert len(cache) == 3
+    assert cache.get((0,)) is None and cache.get((1,)) is None
+    assert cache.get((4,)) is True
+    # a get refreshes recency: (4,) survives the next eviction wave
+    cache.put((5,), True)
+    cache.put((6,), True)
+    assert cache.get((4,)) is True
+
+
+def test_cache_key_distinguishes_level_bits_and_sig():
+    bs1 = BitSet(8)
+    bs1.set(0, True)
+    bs2 = BitSet(8)
+    bs2.set(1, True)
+    ms1 = MultiSignature(bs1, FakeSignature(True))
+    ms2 = MultiSignature(bs2, FakeSignature(True))
+    assert VerifiedAggCache.key(1, ms1) != VerifiedAggCache.key(2, ms1)
+    assert VerifiedAggCache.key(1, ms1) != VerifiedAggCache.key(1, ms2)
+    assert VerifiedAggCache.key(1, ms1) == VerifiedAggCache.key(
+        1, MultiSignature(bs1.clone(), FakeSignature(True))
+    )
+
+
+# -- per-node pipeline -------------------------------------------------------
+
+
+def _proc(verifier, batch_size=4, registry=8):
+    reg = ArrayRegistry(
+        [Identity(i, f"x-{i}", FakePublic(True)) for i in range(registry)]
+    )
+    part = BinomialPartitioner(0, reg)
+    verified = []
+    proc = BatchProcessing(
+        part,
+        FakeConstructor(),
+        b"m",
+        [None] * registry,
+        type("E", (), {"evaluate": staticmethod(lambda sp: 1)})(),
+        verified.append,
+        batch_size=batch_size,
+        verifier=verifier,
+    )
+    return proc, verified
+
+
+def _dup_sig(level, origin, width=2, valid=True):
+    """An aggregate for `level` whose CONTENT is identical across origins —
+    the multi-peer duplicate-delivery shape."""
+    bs = BitSet(width)
+    for i in range(width):
+        bs.set(i, True)
+    return IncomingSig(
+        origin=origin, level=level, ms=MultiSignature(bs, FakeSignature(valid))
+    )
+
+
+def test_in_batch_duplicates_share_one_lane():
+    """Two copies of the same aggregate selected into ONE batch reach the
+    verifier as a single request; both copies still publish."""
+    lanes = []
+
+    async def verifier(msg, pubkeys, requests):
+        lanes.append(len(requests))
+        return [True] * len(requests)
+
+    async def go():
+        proc, verified = _proc(verifier)
+        proc.start()
+        proc.add(_dup_sig(2, origin=2))
+        proc.add(_dup_sig(2, origin=3))  # same content, different peer
+        for _ in range(100):
+            await asyncio.sleep(0.01)
+            if len(verified) >= 2:
+                break
+        proc.stop()
+        return proc, verified
+
+    proc, verified = run(go())
+    assert len(verified) == 2  # both copies published
+    assert sum(lanes) == 1  # ... from ONE device lane
+    assert proc.dedup.hits >= 1
+    assert proc.values()["dedupHits"] >= 1.0
+
+
+def test_rereceived_again_after_verify_costs_no_lane():
+    """An aggregate re-delivered after this node already verified it takes
+    the cached verdict: zero requests reach the device."""
+    lanes = []
+
+    async def verifier(msg, pubkeys, requests):
+        lanes.append(len(requests))
+        return [True] * len(requests)
+
+    async def go():
+        proc, verified = _proc(verifier)
+        proc.start()
+        proc.add(_dup_sig(2, origin=2))
+        for _ in range(100):
+            await asyncio.sleep(0.01)
+            if len(verified) >= 1:
+                break
+        assert sum(lanes) == 1
+        proc.add(_dup_sig(2, origin=3))  # the same winning aggregate again
+        for _ in range(100):
+            await asyncio.sleep(0.01)
+            if len(verified) >= 2:
+                break
+        proc.stop()
+        return proc, verified
+
+    proc, verified = run(go())
+    assert len(verified) == 2
+    assert sum(lanes) == 1  # second delivery never reached the verifier
+
+
+def test_cached_negative_verdict_blocks_republish():
+    """A known-bad aggregate re-sent by a byzantine peer is rejected from
+    cache: no lane, no publish."""
+    lanes = []
+
+    async def verifier(msg, pubkeys, requests):
+        lanes.append(len(requests))
+        return [False] * len(requests)
+
+    async def go():
+        proc, verified = _proc(verifier)
+        proc.start()
+        proc.add(_dup_sig(2, origin=2, valid=False))
+        for _ in range(50):
+            await asyncio.sleep(0.01)
+            if sum(lanes) >= 1:
+                break
+        proc.add(_dup_sig(2, origin=3, valid=False))
+        await asyncio.sleep(0.1)
+        proc.stop()
+        return proc, verified
+
+    proc, verified = run(go())
+    assert not verified
+    assert sum(lanes) == 1
+    assert proc.dedup.hits >= 1
+
+
+def test_verifier_error_requeues_duplicates_too():
+    """On a transient verifier error the in-batch duplicate is requeued with
+    its primary, not silently dropped."""
+    calls = {"n": 0}
+
+    async def flaky(msg, pubkeys, requests):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("transient")
+        return [True] * len(requests)
+
+    async def go():
+        proc, verified = _proc(flaky)
+        proc.start()
+        proc.add(_dup_sig(2, origin=2))
+        proc.add(_dup_sig(2, origin=3))
+        for _ in range(200):
+            await asyncio.sleep(0.01)
+            if len(verified) >= 2:
+                break
+        proc.stop()
+        return verified
+
+    verified = run(go())
+    assert len(verified) == 2
+
+
+# -- process-wide service plane ----------------------------------------------
+
+
+class StubDevice:
+    """BN254Device stand-in: instant verdicts, no kernels. `gate` (when set)
+    blocks dispatch inside the executor thread — the stop()-mid-launch
+    window."""
+
+    batch_size = 4
+
+    def __init__(self, gate: threading.Event | None = None):
+        self.gate = gate
+        self.dispatched = 0
+
+    def dispatch(self, msg, reqs):
+        if self.gate is not None:
+            self.gate.wait(5.0)
+        self.dispatched += len(reqs)
+        return len(reqs)
+
+    def fetch(self, handle):
+        return [True] * handle
+
+
+def _service(device):
+    from handel_tpu.parallel.batch_verifier import BatchVerifierService
+
+    return BatchVerifierService(device, max_delay_ms=0.5)
+
+
+def _req(i=0, width=4):
+    bs = BitSet(width)
+    bs.set(i % width, True)
+    return (bs, FakeSignature(True))
+
+
+def test_service_dedups_across_nodes():
+    """Node B verifying the aggregate node A already verified resolves from
+    cache: the device sees it once."""
+
+    async def go():
+        svc = _service(StubDevice())
+        a = await svc.verify(b"m", [], [_req(0)])
+        b = await svc.verify(b"m", [], [_req(0)])  # same content, other node
+        vals = svc.values()
+        svc.stop()
+        return a, b, svc, vals
+
+    a, b, svc, vals = run(go())
+    assert a == [True] and b == [True]
+    assert svc.device.dispatched == 1
+    assert vals["dedupHits"] == 1.0
+    assert vals["dedupHitRate"] == 0.5
+
+
+def test_service_coalesces_concurrent_duplicates():
+    """Identical candidates in flight at the same time share ONE lane."""
+
+    async def go():
+        svc = _service(StubDevice())
+        r = await asyncio.gather(
+            svc.verify(b"m", [], [_req(1)]),
+            svc.verify(b"m", [], [_req(1)]),
+            svc.verify(b"m", [], [_req(1)]),
+        )
+        svc.stop()
+        return r, svc
+
+    results, svc = run(go())
+    assert results == [[True], [True], [True]]
+    assert svc.device.dispatched == 1
+    assert svc.cache.hits == 2
+
+
+def test_service_distinct_messages_not_deduped():
+    async def go():
+        svc = _service(StubDevice())
+        await svc.verify(b"m1", [], [_req(0)])
+        await svc.verify(b"m2", [], [_req(0)])
+        svc.stop()
+        return svc
+
+    svc = run(go())
+    assert svc.device.dispatched == 2
+
+
+def test_stop_mid_dispatch_fails_waiters_not_hangs():
+    """Regression (ADVICE r5 #1): stop() while the collector holds a batch
+    in the dispatch executor — outside _pending and _fetch_q — must fail
+    that batch's futures instead of stranding the callers forever."""
+
+    async def go():
+        gate = threading.Event()
+        svc = _service(StubDevice(gate=gate))
+        task = asyncio.ensure_future(svc.verify(b"m", [], [_req(0)]))
+        # wait until the batch left _pending for the dispatch executor
+        for _ in range(200):
+            await asyncio.sleep(0.005)
+            if svc._collecting is not None:
+                break
+        assert svc._collecting is not None, "collector never took the batch"
+        svc.stop()
+        gate.set()  # let the executor thread exit
+        with pytest.raises(RuntimeError, match="stopped"):
+            await asyncio.wait_for(task, timeout=2.0)
+
+    run(go())
+
+
+def test_stop_with_pending_queue_still_fails_everyone():
+    """stop() failing _pending (the pre-existing path) keeps working with
+    the dedup layer in front."""
+
+    async def go():
+        gate = threading.Event()
+        svc = _service(StubDevice(gate=gate))
+        t1 = asyncio.ensure_future(svc.verify(b"m", [], [_req(0)]))
+        t2 = asyncio.ensure_future(svc.verify(b"m", [], [_req(0)]))  # coalesced
+        t3 = asyncio.ensure_future(svc.verify(b"m", [], [_req(1)]))
+        await asyncio.sleep(0.05)
+        svc.stop()
+        gate.set()
+        for t in (t1, t2, t3):
+            with pytest.raises(RuntimeError, match="stopped"):
+                await asyncio.wait_for(t, timeout=2.0)
+
+    run(go())

@@ -1,0 +1,166 @@
+"""Vectorized launch packing: equivalence with the per-candidate oracle.
+
+The device packer (`BN254Device._pack_requests`) builds every launch input —
+range bounds, missing-signer patch, dense mask, packed signature limbs —
+with array-at-once numpy ops over the batch. It must be BIT-IDENTICAL to
+the old per-candidate loop (`_pack_requests_loop`, kept as the oracle) for
+every signer-set shape: contiguous ranges, ranges with holes in both
+quantization classes, scattered sets past the MISS_CAP, empty bitsets,
+point-less signatures, and partial batches.
+
+Fast tier: packing is pure host numpy — nothing here compiles a kernel.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from handel_tpu import native as nat
+from handel_tpu.core.bitset import BitSet
+from handel_tpu.models.bn254 import BN254PublicKey, BN254Signature
+from handel_tpu.models.bn254_jax import BN254Device
+from handel_tpu.ops import bn254_ref as bn
+from handel_tpu.ops.fp import Field
+
+N = 130  # > MISS_CAP + 3 so the dense fallback class is reachable
+C = 8
+
+
+@pytest.fixture(scope="module")
+def device():
+    rng = random.Random(11)
+    sks = [rng.randrange(1, 1 << 20) for _ in range(N)]
+    pks = [BN254PublicKey(p) for p in nat.g2_mul_batch([bn.G2_GEN] * N, sks)]
+    return BN254Device(pks, batch_size=C)
+
+
+def _rand_request(rng, kind):
+    bs = BitSet(N)
+    if kind == "empty":
+        return (bs, BN254Signature(bn.G1_GEN))
+    if kind == "nosig":
+        for i in rng.sample(range(N), 5):
+            bs.set(i, True)
+        return (bs, object())  # no .point: lane must be masked out
+    max_holes = {"range8": 9, "range64": 60, "dense": None}[kind]
+    size = rng.randrange(1, N)
+    lo = rng.randrange(0, N - size + 1)
+    n_holes = rng.randrange(0, size if max_holes is None else min(size, max_holes))
+    holes = set(rng.sample(range(lo, lo + size), n_holes))
+    holes.discard(lo)  # keep the hull anchored so hole counts stay exact
+    holes.discard(lo + size - 1)
+    for i in range(lo, lo + size):
+        if i not in holes:
+            bs.set(i, True)
+    return (bs, BN254Signature(bn.G1_GEN))
+
+
+def _assert_plans_equal(a, b, ctx):
+    assert a.kind == b.kind, ctx
+    assert a.miss_k == b.miss_k, ctx
+    for f in ("lo", "hi", "miss_idx", "miss_ok", "mask", "valid"):
+        x, y = getattr(a, f), getattr(b, f)
+        assert (x is None) == (y is None), (ctx, f)
+        if x is not None:
+            x, y = np.asarray(x), np.asarray(y)
+            assert x.dtype == y.dtype, (ctx, f, x.dtype, y.dtype)
+            assert x.shape == y.shape and (x == y).all(), (ctx, f)
+    for f in ("sig_x", "sig_y"):
+        x, y = np.asarray(getattr(a, f)), np.asarray(getattr(b, f))
+        assert x.dtype == y.dtype and (x == y).all(), (ctx, f)
+
+
+def test_pack_requests_matches_loop_property(device):
+    """Random batches across all request shapes: the vectorized packer and
+    the per-candidate loop must produce bit-identical device inputs."""
+    rng = random.Random(23)
+    kinds = ["empty", "nosig", "range8", "range64", "dense"]
+    for trial in range(120):
+        reqs = [
+            _rand_request(rng, rng.choice(kinds))
+            for _ in range(rng.randrange(1, C + 1))
+        ]
+        vec = device._pack_requests(reqs)
+        # the vectorized plan views reused staging buffers: snapshot before
+        # anything else can touch them
+        vec = vec._replace(
+            **{
+                f: np.asarray(getattr(vec, f)).copy()
+                for f in ("lo", "hi", "miss_idx", "miss_ok", "mask", "valid")
+                if getattr(vec, f) is not None
+            }
+        )
+        loop = device._pack_requests_loop(reqs)
+        _assert_plans_equal(vec, loop, trial)
+
+
+def test_pack_requests_class_selection(device):
+    """The two range quantization classes and the dense fallback trigger at
+    the same thresholds as the old loop: <=8 holes -> miss_k=8, <=64 ->
+    miss_k=64, >64 -> dense."""
+    sig = BN254Signature(bn.G1_GEN)
+
+    def req_with_holes(n_holes):
+        bs = BitSet(N)
+        width = n_holes + 2
+        for i in range(width):
+            bs.set(i, True)
+        for i in range(1, 1 + n_holes):
+            bs.set(i, False)
+        return (bs, sig)
+
+    for n_holes, kind, miss_k in ((0, "range", 8), (8, "range", 8),
+                                  (9, "range", 64), (64, "range", 64),
+                                  (65, "dense", 0)):
+        plan = device._pack_requests([req_with_holes(n_holes)])
+        assert (plan.kind, plan.miss_k) == (kind, miss_k), n_holes
+
+
+def test_pack_requests_rejects_wrong_length(device):
+    bs = BitSet(N + 1)
+    bs.set(0, True)
+    with pytest.raises(ValueError, match="bitset length"):
+        device._pack_requests([(bs, BN254Signature(bn.G1_GEN))])
+    with pytest.raises(ValueError, match="bitset length"):
+        device._pack_requests_loop([(bs, BN254Signature(bn.G1_GEN))])
+
+
+def test_field_pack_batch_matches_pack():
+    """The array-at-once limb packer is bit-identical to the per-element
+    reference for random field elements, in and out of Montgomery form."""
+    F = Field(bn.P)
+    rng = random.Random(7)
+    xs = [rng.randrange(0, bn.P) for _ in range(64)] + [0, 1, bn.P - 1]
+    for mont in (True, False):
+        a = np.asarray(F.pack(xs, mont=mont))
+        b = np.asarray(F.pack_batch(xs, mont=mont))
+        assert a.dtype == b.dtype and a.shape == b.shape
+        assert (a == b).all()
+
+
+def test_batch_verify_bounds_dispatch_window(device, monkeypatch):
+    """batch_verify never runs more than MAX_DISPATCH_AHEAD chunks ahead of
+    the fetch cursor (ADVICE r5 #3: an unbounded window kept every chunk's
+    upload buffers resident on device simultaneously)."""
+    in_flight = {"now": 0, "max": 0}
+    serial = iter(range(1000))
+
+    def fake_dispatch(msg, reqs):
+        in_flight["now"] += 1
+        in_flight["max"] = max(in_flight["max"], in_flight["now"])
+        return ("h", next(serial), len(reqs))
+
+    def fake_fetch(handle):
+        in_flight["now"] -= 1
+        return [True] * handle[2]
+
+    monkeypatch.setattr(device, "dispatch", fake_dispatch)
+    monkeypatch.setattr(device, "fetch", fake_fetch)
+    bs = BitSet(N)
+    bs.set(0, True)
+    reqs = [(bs, BN254Signature(bn.G1_GEN))] * (C * 12)
+    out = device.batch_verify(b"m", reqs)
+    assert len(out) == C * 12
+    assert in_flight["max"] <= device.MAX_DISPATCH_AHEAD
+    assert in_flight["now"] == 0

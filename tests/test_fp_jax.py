@@ -84,15 +84,23 @@ def test_pow_const_and_inv(F):
     assert F.unpack(inv) == [pow(x, -1, bn.P) for x in xs]
 
 
-def test_pow_const_windowed_edges(F):
-    """The windowed digit scan across its edge shapes: exponents at/below the
+@pytest.mark.parametrize("window", [1, 4])
+def test_pow_const_windowed_edges(F, window):
+    """Both pow lowerings across their edge shapes: exponents at/below the
     window width (direct-chain branch), widths that pad, digits of 0 (skip
-    lanes), and agreement with python pow on irregular bit patterns."""
+    lanes), and agreement with python pow on irregular bit patterns.
+
+    The window is pinned EXPLICITLY (ADVICE r5 #2): default_pow_window
+    returns 1 on the CPU CI backend, so leaving it to the default would
+    silently drop coverage of the window=4 table+gather lowering — the
+    production path on accelerators."""
     xs = rand_elems(3)
     ax = F.pack(xs)
     for e in (2, 3, 15, 16, 17, 0x8001, 0x10010, 0xF0F0F0F, bn.P - 2):
-        got = F.unpack(jax.jit(lambda a, e=e: F.pow_const(a, e))(ax))
-        assert got == [pow(x, e, bn.P) for x in xs], f"e={e:#x}"
+        got = F.unpack(
+            jax.jit(lambda a, e=e: F.pow_const(a, e, window=window))(ax)
+        )
+        assert got == [pow(x, e, bn.P) for x in xs], f"e={e:#x} w={window}"
 
 
 def test_windowed_pow_digits():

@@ -85,3 +85,50 @@ def test_bls12_381_jax_device_end_to_end():
         assert verify_multisignature(
             MSG, sig, cluster.registry, scheme.constructor
         )
+
+
+@pytest.mark.slow
+def test_warmup_then_round_zero_xla_compiles():
+    """Acceptance for the startup-warmup plane: scheme construction
+    (prepare + BN254Device.warmup) compiles every kernel class a round can
+    reach, so a full protocol round afterwards triggers ZERO new XLA
+    compilations — before warmup, the first candidate in a fresh hole-count
+    class stalled its whole verification round on a mid-run compile."""
+    import jax._src.monitoring as jmon
+
+    from handel_tpu.models.bn254_jax import BN254JaxScheme
+
+    scheme = BN254JaxScheme(batch_size=4)  # warmup=True is the default
+
+    async def go():
+        # n=12 >= 11: BOTH quantized range classes (miss_k 8 and 64) are
+        # reachable and warmed; the dense fallback needs >64 holes, which a
+        # 12-key registry cannot produce, and is correctly skipped
+        cluster = LocalCluster(12, scheme=scheme, msg=MSG)
+        scheme.constructor.prepare(
+            [cluster.registry.identity(i).public_key for i in range(12)]
+        )
+        compiles: list[str] = []
+
+        def listener(name: str, duration: float, **kw) -> None:
+            if name.startswith("/jax/core/compile/backend_compile"):
+                compiles.append(name)
+
+        jmon.register_event_duration_secs_listener(listener)
+        try:
+            cluster.start()
+            try:
+                res = await cluster.wait_complete_success(timeout=900.0)
+            finally:
+                cluster.stop()
+        finally:
+            jmon._unregister_event_duration_listener_by_callback(listener)
+        return cluster, res, compiles
+
+    cluster, results, compiles = asyncio.run(go())
+    assert len(results) == 12
+    for sig in results.values():
+        assert sig.cardinality() >= cluster.threshold
+    assert compiles == [], (
+        f"round triggered {len(compiles)} XLA compiles after warmup"
+    )

@@ -6,8 +6,18 @@ peer share a single session).
 """
 
 import asyncio
+import importlib.util
 
 import pytest
+
+# the TLS handshake paths mint a self-signed certificate through the
+# `cryptography` package; on images without it (this container ships none,
+# and the image is sealed — no pip install) those tests are gated, not
+# failed. The session-manager semantics below run regardless.
+needs_cryptography = pytest.mark.skipif(
+    importlib.util.find_spec("cryptography") is None,
+    reason="cryptography package not installed (sealed image)",
+)
 
 from handel_tpu.core.identity import Identity
 from handel_tpu.core.net import Packet
@@ -19,6 +29,7 @@ from handel_tpu.network.quic import (
 from tests.test_network import ChanListener, _free_ports, _mk_packet
 
 
+@needs_cryptography
 def test_two_node_exchange_tls():
     async def go():
         p1, p2 = _free_ports(2)
@@ -95,6 +106,7 @@ def test_session_manager_dial_failure_propagates():
     asyncio.run(go())
 
 
+@needs_cryptography
 def test_insecure_config_roundtrip():
     server_ctx, client_ctx = new_insecure_test_config()
     import ssl

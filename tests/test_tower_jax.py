@@ -89,19 +89,22 @@ def test_f12_pow_u(T):
     assert got == [bn.f12_pow(x, bn.U) for x in xs]
 
 
-def test_f12_pow_const_windowed_and_unroll(T):
-    """Small exponents keep both lowerings compile-cheap on CPU: the windowed
-    digit scan (production path) and the static unroll (the flag offered to
-    co-located deployments) must agree with the oracle — the unroll branch
-    would otherwise rot untested since no default path takes it."""
+@pytest.mark.parametrize("window", [1, 4])
+def test_f12_pow_const_windowed_and_unroll(T, window):
+    """Small exponents keep all lowerings compile-cheap on CPU: the digit
+    scan at BOTH window widths (window=1 bit scan — the CPU default — and
+    window=4 table+gather — the accelerator production path, pinned
+    explicitly per ADVICE r5 #2 so CPU CI keeps oracle-checking it) and the
+    static unroll (the flag offered to co-located deployments) must agree
+    with the oracle — untaken branches would otherwise rot untested."""
     xs = rand_f12s(2)
     ax = T.f12_pack(xs)
     for e in (3, 16, 0x1D, 0x113):
         want = [bn.f12_pow(x, e) for x in xs]
         windowed = T.f12_unpack(
-            jax.jit(lambda a, e=e: T.f12_pow_const(a, e))(ax)
+            jax.jit(lambda a, e=e: T.f12_pow_const(a, e, window=window))(ax)
         )
-        assert windowed == want, f"windowed e={e:#x}"
+        assert windowed == want, f"windowed e={e:#x} w={window}"
         unrolled = T.f12_unpack(
             jax.jit(lambda a, e=e: T.f12_pow_const(a, e, unroll=True))(ax)
         )
