@@ -28,6 +28,7 @@ DEFAULT_UPDATE_PERIOD = 0.010  # seconds, config.go:93
 DEFAULT_UPDATE_COUNT = 1  # config.go:97
 DEFAULT_LEVEL_TIMEOUT = 0.050  # seconds, timeout.go:31
 DEFAULT_BATCH_SIZE = 16  # TPU verify batch per launch
+DEFAULT_MAX_PENDING = 4096  # inbound verification queue bound (flood defense)
 
 
 def percentage_to_contributions(perc: int, n: int) -> int:
@@ -68,6 +69,18 @@ class Config:
     disable_shuffling: bool = False
     # test knob: replace verification by a sleep of this many ms (config.go:61-65)
     unsafe_sleep_on_verify_ms: int = 0
+
+    # -- byzantine hardening (core/penalty.py) -----------------------------
+    # attribute failed verifications / unparseable packets to their origin,
+    # demote then ban persistent offenders. None disables peer accounting.
+    # (handel, ) -> PeerScorer; the default builds one with the thresholds
+    # from core/penalty.py
+    new_scorer: Optional[Callable] = None
+    penalize_peers: bool = True
+    # cap on queued unverified candidates per node; beyond it the OLDEST
+    # pending candidate is dropped, so a flooder bounds host memory instead
+    # of growing it (core/processing.py)
+    max_pending: int = DEFAULT_MAX_PENDING
 
     # -- TPU batch plane ---------------------------------------------------
     # max candidates per device verification launch

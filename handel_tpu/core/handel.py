@@ -24,6 +24,10 @@ from handel_tpu.core.crypto import Constructor, MultiSignature, Signature
 from handel_tpu.core.identity import Identity, Registry, shuffle
 from handel_tpu.core.net import Network, Packet
 from handel_tpu.core.partitioner import IncomingSig
+from handel_tpu.core.penalty import (
+    WEIGHT_PARSE_FAIL,
+    PeerScorer,
+)
 from handel_tpu.core.processing import BatchProcessing
 from handel_tpu.core.store import SignatureStore
 from handel_tpu.core.timeout import LinearTimeout
@@ -32,7 +36,13 @@ from handel_tpu.core.timeout import LinearTimeout
 class Level:
     """Per-level send/receive state (handel.go:443-580)."""
 
-    def __init__(self, id: int, nodes: Sequence[Identity], send_expected_full_size: int):
+    def __init__(
+        self,
+        id: int,
+        nodes: Sequence[Identity],
+        send_expected_full_size: int,
+        scorer: PeerScorer | None = None,
+    ):
         if id <= 0:
             raise ValueError("level id must be >= 1")
         self.id = id
@@ -43,6 +53,12 @@ class Level:
         self.send_peers_ct = 0
         self.send_expected_full_size = send_expected_full_size
         self.send_sig_size = 0
+        # peer penalty plane (core/penalty.py): banned peers are skipped,
+        # demoted peers get every other update
+        self.scorer = scorer
+        self._demote_tick: dict[int, int] = {}
+        self.banned_skips = 0
+        self.demote_skips = 0
 
     def active(self) -> bool:
         """Started and not yet done contacting every peer with the current
@@ -53,12 +69,41 @@ class Level:
         self.send_started = True
 
     def select_next_peers(self, count: int) -> list[Identity]:
-        """Rolling window over the (shuffled) peer list (handel.go:544-558)."""
+        """Rolling window over the (shuffled) peer list (handel.go:544-558).
+
+        With a scorer attached, banned peers never get a slot (sending to a
+        peer we refuse to hear from is pure waste) and demoted peers are
+        handed only every other update — offenders fall behind honest peers
+        without being cut off on a single bad packet. The scan is bounded so
+        an all-banned level degrades to an empty selection, not a spin.
+        """
         size = min(count, len(self.nodes))
-        res = []
-        for _ in range(size):
-            res.append(self.nodes[self.send_pos])
+        if self.scorer is None:
+            res = []
+            for _ in range(size):
+                res.append(self.nodes[self.send_pos])
+                self.send_pos = (self.send_pos + 1) % len(self.nodes)
+            self.send_peers_ct += size
+            return res
+
+        res: list[Identity] = []
+        # at most one full pass: each peer considered once per selection, so
+        # skips shrink the selection instead of double-sending to survivors
+        for _ in range(len(self.nodes)):
+            if len(res) >= size:
+                break
+            peer = self.nodes[self.send_pos]
             self.send_pos = (self.send_pos + 1) % len(self.nodes)
+            if self.scorer.banned(peer.id):
+                self.banned_skips += 1
+                continue
+            if self.scorer.demoted(peer.id):
+                tick = self._demote_tick.get(peer.id, 0) + 1
+                self._demote_tick[peer.id] = tick
+                if tick % 2 == 1:
+                    self.demote_skips += 1
+                    continue
+            res.append(peer)
         self.send_peers_ct += size
         return res
 
@@ -77,7 +122,9 @@ class Level:
         return False
 
 
-def create_levels(config: Config, partitioner) -> dict[int, Level]:
+def create_levels(
+    config: Config, partitioner, scorer: PeerScorer | None = None
+) -> dict[int, Level]:
     """Build all levels, shuffling candidate order per level (handel.go:498-519).
 
     send_expected_full_size accumulates 1 (own sig) + the sizes of all lower
@@ -90,7 +137,7 @@ def create_levels(config: Config, partitioner) -> dict[int, Level]:
         nodes = list(partitioner.identities_at(lvl))
         if not config.disable_shuffling:
             shuffle(nodes, config.rand)
-        levels[lvl] = Level(lvl, nodes, send_expected_full_size)
+        levels[lvl] = Level(lvl, nodes, send_expected_full_size, scorer)
         send_expected_full_size += len(nodes)
         if not first_active:
             levels[lvl].set_started()
@@ -124,8 +171,17 @@ class Handel:
         self.sig = own_sig
         self.log = self.c.logger.with_fields(id=identity.id)
 
+        # byzantine peer accounting (core/penalty.py): failed verifications
+        # and unparseable packets are attributed back to the packet origin
+        if self.c.penalize_peers:
+            self.scorer = (
+                self.c.new_scorer(self) if self.c.new_scorer else PeerScorer()
+            )
+        else:
+            self.scorer = None
+
         self.partitioner = self.c.new_partitioner(identity.id, registry, self.log)
-        self.levels = create_levels(self.c, self.partitioner)
+        self.levels = create_levels(self.c, self.partitioner, self.scorer)
         self.ids = self.partitioner.levels()
         self.threshold = self.c.contributions
         self.done = False
@@ -165,6 +221,8 @@ class Handel:
             batch_size=self.c.batch_size,
             verifier=self.c.verifier,
             unsafe_sleep_ms=self.c.unsafe_sleep_on_verify_ms,
+            max_pending=self.c.max_pending,
+            on_verify_failed=self._on_verify_failed,
             logger=self.log,
         )
         self.net.register_listener(self)
@@ -177,6 +235,12 @@ class Handel:
         # minimal stats (handel.go:594-598) + reporter hook
         self.msg_sent_ct = 0
         self.msg_rcv_ct = 0
+        self.invalid_packet_ct = 0
+        self.banned_packet_ct = 0
+        # warn-once log keys: a flooder spamming malformed packets must not
+        # turn the log itself into the DoS — first offense per reason is
+        # WARN, the rest are debug + counters
+        self._warned: set[str] = set()
         self._periodic_task: asyncio.Task | None = None
 
     # -- lifecycle (handel.go:156-182) -------------------------------------
@@ -218,23 +282,45 @@ class Handel:
         try:
             self._validate_packet(p)
         except ValueError as e:
-            self.log.warn("invalid_packet", e)
+            self.invalid_packet_ct += 1
+            self._warn_once("invalid_packet", e)
             return
         try:
             ms, ind = self._parse_signatures(p)
         except ValueError as e:
-            self.log.warn("invalid_packet_multisig", e)
+            self.invalid_packet_ct += 1
+            self._warn_once("invalid_packet_multisig", e)
+            # an unparseable payload from an in-range origin is attributed
+            # (at low weight — a corrupting link blames an honest sender)
+            if self.scorer is not None:
+                self.scorer.report(p.origin, WEIGHT_PARSE_FAIL)
             return
         if not self.levels[p.level].rcv_completed:
             self.proc.add(ms)
             if ind is not None:
                 self.proc.add(ind)
 
+    def _warn_once(self, key: str, detail) -> None:
+        """WARN on the first occurrence per reason, debug after — a flooder
+        cannot turn per-packet logging into the attack."""
+        if key not in self._warned:
+            self._warned.add(key)
+            self.log.warn(key, detail)
+        else:
+            self.log.debug(key, detail)
+
     def _validate_packet(self, p: Packet) -> None:
-        """Origin/level range checks (handel.go:373-386)."""
+        """Origin/level range + byzantine checks (handel.go:373-386), all
+        BEFORE any signature bytes are parsed: a reflected or spoofed-origin
+        packet costs an integer compare, never an unmarshal."""
         self.msg_rcv_ct += 1
         if p.origin < 0 or p.origin >= self.reg.size():
             raise ValueError("packet's origin out of range")
+        if p.origin == self.id.id:
+            raise ValueError("packet claims to originate from this node")
+        if self.scorer is not None and self.scorer.banned(p.origin):
+            self.banned_packet_ct += 1
+            raise ValueError(f"origin {p.origin} is banned")
         if p.level not in self.levels:
             raise ValueError(f"invalid packet level {p.level}")
 
@@ -276,6 +362,13 @@ class Handel:
         self.store.store(sp)
         self._check_completed_level(sp)
         self._check_final_signature(sp)
+
+    def _on_verify_failed(self, sp: IncomingSig) -> None:
+        """A candidate failed its pairing check: penalize the packet origin
+        (honest nodes only forward verified content, so a bad signature is
+        strong evidence against the sender — core/penalty.py)."""
+        if self.scorer is not None and sp.origin >= 0:
+            self.scorer.report(sp.origin)
 
     def _check_final_signature(self, sp: IncomingSig) -> None:
         """Emit a new best full signature above the threshold (handel.go:271-296)."""
@@ -354,9 +447,20 @@ class Handel:
     # -- reporting ---------------------------------------------------------
 
     def values(self) -> dict[str, float]:
-        return {
+        out = {
             "msgSentCt": float(self.msg_sent_ct),
             "msgRcvCt": float(self.msg_rcv_ct),
+            "invalidPacketCt": float(self.invalid_packet_ct),
+            "bannedPacketCt": float(self.banned_packet_ct),
             **self.proc.values(),
             **self.store.values(),
         }
+        if self.scorer is not None:
+            out.update(self.scorer.values())
+            out["peerBannedSkips"] = float(
+                sum(lvl.banned_skips for lvl in self.levels.values())
+            )
+            out["peerDemoteSkips"] = float(
+                sum(lvl.demote_skips for lvl in self.levels.values())
+            )
+        return out

@@ -101,6 +101,8 @@ class BatchProcessing:
         verifier: AsyncVerifier | None = None,
         unsafe_sleep_ms: int = 0,
         dedup_cache: VerifiedAggCache | None = None,
+        max_pending: int = 4096,
+        on_verify_failed: Callable[[IncomingSig], None] | None = None,
         logger: Logger = DEFAULT_LOGGER,
     ):
         self.part = part
@@ -115,15 +117,24 @@ class BatchProcessing:
         self.log = logger
         self.filter: Filter = IndividualSigFilter()
         self.max_retries = 3  # per-candidate verifier-error retry budget
+        self.max_pending = max(1, max_pending)
+        # byzantine attribution hook: called with the candidate whose
+        # verification FAILED, so the node can penalize the packet origin
+        # (core/penalty.py via Handel._on_verify_failed)
+        self.on_verify_failed = on_verify_failed
         # verified-aggregate dedup: Handel re-receives the same winning
         # aggregate from several peers per level; each copy this node has
         # already judged short-circuits here instead of burning a device lane
         self.dedup = dedup_cache or VerifiedAggCache()
 
         # priority queue of (-score, seq, sig): scored once at enqueue, lazily
-        # re-scored at dequeue (see _select_batch). `_todos` stays a plain
-        # list for the FIFO subclass, unused by the heap path.
+        # re-scored at dequeue (see _select_batch). `_live` maps seq -> sig
+        # for every entry still pending; its dict insertion order IS arrival
+        # order, which makes the flood bound's drop-oldest O(1): evict the
+        # first key, and let the heap skip the dead seq lazily at pop.
+        # `_todos` stays a plain list for the FIFO subclass, unused here.
         self._heap: list[tuple[int, int, IncomingSig]] = []
+        self._live: dict[int, IncomingSig] = {}
         self._dirty = False  # store changed since last rebuild → scores stale
         self._seq = 0
         self._todos: list[IncomingSig] = []
@@ -135,6 +146,8 @@ class BatchProcessing:
         self.sig_checked_ct = 0
         self.sig_queue_size = 0
         self.sig_suppressed = 0
+        self.sig_dropped_overflow = 0
+        self.sig_verify_failed = 0
         self.sig_checking_time_ms = 0.0
 
     # -- lifecycle ---------------------------------------------------------
@@ -160,7 +173,11 @@ class BatchProcessing:
         """Score once and push; worthless candidates die at the door
         (the reference prunes score-0 todos on every pass,
         processing.go:171-220 — here they are pruned at enqueue and again
-        at dequeue, never verified)."""
+        at dequeue, never verified). The pending set is BOUNDED: past
+        `max_pending` the oldest queued candidate is evicted (drop-oldest —
+        under a flood the oldest entries are the stalest, and the
+        protocol's periodic resend recovers anything that mattered), so a
+        flooder cannot grow host memory."""
         if sp.ms is None:
             self.sig_suppressed += 1
             return
@@ -170,13 +187,24 @@ class BatchProcessing:
             return
         self._seq += 1
         heapq.heappush(self._heap, (-mark, self._seq, sp))
+        self._live[self._seq] = sp
+        if len(self._live) > self.max_pending:
+            oldest = next(iter(self._live))  # dict order = arrival order
+            del self._live[oldest]  # its heap entry dies lazily at pop
+            self.sig_dropped_overflow += 1
+        if len(self._heap) > 2 * self.max_pending:
+            # a sustained flood evicts faster than pops drain: compact the
+            # dead heap entries so the heap itself stays bounded. Triggered
+            # at most once per max_pending enqueues — O(1) amortized.
+            self._heap = [e for e in self._heap if e[1] in self._live]
+            heapq.heapify(self._heap)
 
     def _queue_len(self) -> int:
-        return len(self._heap)
+        return len(self._live)
 
     def pending(self) -> list[IncomingSig]:
         """Snapshot of queued candidates (test/introspection hook)."""
-        return [sp for _, _, sp in self._heap]
+        return list(self._live.values())
 
     # -- processing loop ---------------------------------------------------
 
@@ -222,9 +250,12 @@ class BatchProcessing:
             stale = self._heap
             self._heap = []
             for _, seq, sp in stale:
+                if seq not in self._live:
+                    continue  # overflow-evicted: already counted at drop
                 fresh = self.evaluator.evaluate(sp) if sp.ms is not None else 0
                 if fresh <= 0:
                     self.sig_suppressed += 1
+                    del self._live[seq]
                 else:
                     self._heap.append((-fresh, seq, sp))
             heapq.heapify(self._heap)
@@ -232,17 +263,21 @@ class BatchProcessing:
         batch: list[IncomingSig] = []
         while self._heap and len(batch) < self.batch_size:
             neg, seq, sp = heapq.heappop(self._heap)
+            if seq not in self._live:
+                continue  # overflow-evicted: already counted at drop
             fresh = self.evaluator.evaluate(sp) if sp.ms is not None else 0
             if fresh <= 0:
                 self.sig_suppressed += 1
+                del self._live[seq]
                 continue
             if fresh != -neg:
                 heapq.heappush(self._heap, (-fresh, seq, sp))
                 continue
+            del self._live[seq]
             batch.append(sp)
 
         self.sig_checked_ct += len(batch)
-        self.sig_queue_size += len(self._heap)
+        self.sig_queue_size += self._queue_len()
         return batch
 
     async def _verify_and_publish(self, batch: list[IncomingSig]) -> None:
@@ -321,9 +356,19 @@ class BatchProcessing:
                 # scores — rebuild before the next selection (_select_batch)
                 self._dirty = True
             else:
-                self.log.warn(
-                    "verify_failed", f"origin={sp.origin} level={sp.level}"
+                self.sig_verify_failed += 1
+                # warn-once: a byzantine peer can force unlimited failures;
+                # the counter + penalty attribution carry the signal
+                log = (
+                    self.log.warn
+                    if self.sig_verify_failed == 1
+                    else self.log.debug
                 )
+                log("verify_failed", f"origin={sp.origin} level={sp.level}")
+                if self.on_verify_failed is not None:
+                    # attribute the bad signature to the packet origin so
+                    # the node can demote/ban a byzantine peer
+                    self.on_verify_failed(sp)
 
     def _requeue(self, batch: list[IncomingSig]) -> None:
         """Put errored candidates back on the todo queue, up to max_retries
@@ -363,6 +408,8 @@ class BatchProcessing:
             "sigCheckedCt": float(checked),
             "sigQueueSize": self.sig_queue_size / checked if checked else 0.0,
             "sigSuppressed": float(self.sig_suppressed),
+            "sigDroppedOverflow": float(self.sig_dropped_overflow),
+            "sigVerifyFailed": float(self.sig_verify_failed),
             "sigCheckingTime": (
                 self.sig_checking_time_ms / checked if checked else 0.0
             ),
@@ -386,6 +433,9 @@ class FifoProcessing(BatchProcessing):
 
     def _enqueue(self, sp: IncomingSig) -> None:
         self._todos.append(sp)
+        if len(self._todos) > self.max_pending:  # same drop-oldest bound
+            self._todos.pop(0)
+            self.sig_dropped_overflow += 1
 
     def _queue_len(self) -> int:
         return len(self._todos)

@@ -24,6 +24,7 @@ from handel_tpu.core.handel import Handel
 from handel_tpu.core.identity import ArrayRegistry, Identity
 from handel_tpu.core.net import Listener, Packet
 from handel_tpu.core.timeout import InfiniteTimeout
+from handel_tpu.network.chaos import ChaosConfig, ChaosNetwork
 
 
 class InProcessRouter:
@@ -88,11 +89,17 @@ class LocalCluster:
         config_factory: Callable[[int], Config] | None = None,
         seed: int = 1,
         loss_rate: float = 0.0,
+        chaos: ChaosConfig | None = None,
+        adversaries: dict[int, str] | None = None,
     ):
         self.n = n
         self.scheme = scheme or FakeScheme()
         self.msg = msg
         self.offline = set(offline)
+        # byzantine roles (sim/adversary.py): node id -> role name. These
+        # nodes run — adversarially — so the honest cohort must converge
+        # around them, not without them.
+        self.roles = dict(adversaries or {})
         self.router = InProcessRouter(
             loss_rate=loss_rate, rand=random.Random(seed)
         )
@@ -106,6 +113,8 @@ class LocalCluster:
         self.registry = ArrayRegistry(idents)
 
         self.handels: dict[int, Handel] = {}
+        self.adversaries: dict[int, Handel] = {}
+        has_byzantine = bool(self.offline or self.roles or chaos)
         for i in range(n):
             if i in self.offline:
                 continue  # offline nodes are simply never built (test.go:105-113)
@@ -114,11 +123,27 @@ class LocalCluster:
                 cfg.contributions = threshold
             if cfg.rand is None or config_factory is None:
                 cfg.rand = random.Random(seed + i)
-            if not self.offline and config_factory is None:
+            if not has_byzantine and config_factory is None:
                 # no failures -> no timeouts, so stalls are real bugs
                 # (handel_test.go:99-101, 442-455)
                 cfg.new_timeout = InfiniteTimeout
             net = InProcessNetwork(self.router, f"inproc-{i}")
+            if chaos is not None and chaos.any():
+                net = ChaosNetwork(net, chaos.for_node(i))
+            if i in self.roles:
+                from handel_tpu.sim.adversary import build_adversary
+
+                self.adversaries[i] = build_adversary(
+                    self.roles[i],
+                    net,
+                    self.registry,
+                    idents[i],
+                    cons,
+                    self.msg,
+                    secrets[i],
+                    cfg,
+                )
+                continue
             own_sig = secrets[i].sign(self.msg)
             self.handels[i] = Handel(
                 net, self.registry, idents[i], cons, self.msg, own_sig, cfg
@@ -128,10 +153,14 @@ class LocalCluster:
     def start(self) -> None:
         for h in self.handels.values():
             h.start()
+        for a in self.adversaries.values():
+            a.start()
 
     def stop(self) -> None:
         for h in self.handels.values():
             h.stop()
+        for a in self.adversaries.values():
+            a.stop()
 
     async def wait_complete_success(self, timeout: float = 10.0) -> dict[int, MultiSignature]:
         """Wait until every online node emitted a final signature >= threshold

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from handel_tpu.core.bitset import BitSet
+from handel_tpu.core.logging import DEFAULT_LOGGER
 from handel_tpu.models.bn254 import (
     BN254Constructor,
     BN254PublicKey,
@@ -39,6 +40,7 @@ from handel_tpu.models.bn254 import (
     BN254Signature,
     hash_to_g1,
 )
+from handel_tpu.utils.breaker import CircuitBreaker
 from handel_tpu.ops import bn254_ref as bn
 from handel_tpu.ops.curve import BN254Curves
 from handel_tpu.ops.pairing import BN254Pairing
@@ -651,6 +653,18 @@ class BN254JaxConstructor(BN254Constructor):
     The device registry is built lazily from the pubkey sequence of the first
     call (Handel passes the same registry list every time) or eagerly via
     `prepare()`. Marshal/unmarshal and single-sig verify stay host-side.
+
+    Failover (`host_fallback=True`): device/XLA errors — including a compile
+    or upload failure inside the lazy prepare — feed a circuit breaker, and
+    the batch resolves through the INHERITED host-side serial batch_verify
+    (Constructor.batch_verify over the host pubkey objects, i.e. the
+    ops/bn254_ref reference math; curve-agnostic, so the BLS12-381 subclass
+    inherits the failover too) instead of raising. This covers
+    the per-node default-verifier path the same way BatchVerifierService
+    covers the shared launch queue (parallel/batch_verifier.py): a dead
+    accelerator degrades throughput, it does not stall the node. Request
+    errors (ValueError: malformed bitsets) are the caller's bug and
+    propagate untouched.
     """
 
     Device = BN254Device
@@ -661,11 +675,18 @@ class BN254JaxConstructor(BN254Constructor):
         curves: BN254Curves | None = None,
         mesh_devices: int = 1,
         warmup: bool = True,
+        host_fallback: bool = True,
+        breaker: CircuitBreaker | None = None,
     ):
         self.batch_size = batch_size
         self.mesh_devices = mesh_devices
         self.curves = curves or self.Device.Curves()
         self.warmup = warmup
+        self.host_fallback = host_fallback
+        self.breaker = breaker or CircuitBreaker()
+        self.failover_batches = 0
+        self.failover_candidates = 0
+        self.log = DEFAULT_LOGGER
         self._device: BN254Device | None = None
         self._device_for: int | None = None
 
@@ -704,7 +725,21 @@ class BN254JaxConstructor(BN254Constructor):
         return self._device
 
     def batch_verify(self, msg, pubkeys, requests) -> list[bool]:
-        return self._device_of(pubkeys).batch_verify(msg, requests)
+        if not self.host_fallback:
+            return self._device_of(pubkeys).batch_verify(msg, requests)
+        if self.breaker.allow():
+            try:
+                out = self._device_of(pubkeys).batch_verify(msg, requests)
+                self.breaker.record_success()
+                return out
+            except ValueError:
+                raise  # malformed request, not a device failure
+            except Exception as e:
+                self.breaker.record_failure()
+                self.log.warn("bn254_device_error", e)
+        self.failover_batches += 1
+        self.failover_candidates += len(requests)
+        return super().batch_verify(msg, pubkeys, requests)
 
 
 class BN254JaxScheme(BN254Scheme):

@@ -11,6 +11,7 @@ core/test_harness.py; these sockets carry protocol traffic between hosts
 (parallel/batch_verifier.py), never these sockets.
 """
 
+from handel_tpu.network.chaos import ChaosConfig, ChaosNetwork
 from handel_tpu.network.encoding import (
     BinaryEncoding,
     CounterEncoding,
@@ -24,6 +25,8 @@ __all__ = [
     "Encoding",
     "BinaryEncoding",
     "CounterEncoding",
+    "ChaosConfig",
+    "ChaosNetwork",
     "UDPNetwork",
     "TCPNetwork",
     "QUICNetwork",

@@ -37,8 +37,11 @@ class _Proto(asyncio.DatagramProtocol):
     def datagram_received(self, data: bytes, addr) -> None:
         self.net._enqueue(data)
 
-    def error_received(self, exc) -> None:  # ICMP errors: fire-and-forget
-        pass
+    def error_received(self, exc) -> None:
+        # ICMP errors (port unreachable etc.) stay fire-and-forget for the
+        # protocol, but silently discarding them hid dead peers from every
+        # stall diagnosis — count them on the monitor plane and warn once
+        self.net._icmp_error(exc)
 
 
 class UDPNetwork:
@@ -60,6 +63,10 @@ class UDPNetwork:
         self.sent = 0  # packets out (udp/net.go:212-226)
         self.rcvd = 0  # packets dispatched to listeners
         self.dropped = 0  # queue-full drops
+        self.icmp_errors = 0  # error_received callbacks (ICMP unreachable)
+        self.decode_errors = 0  # malformed datagrams rejected by the codec
+        self._warned_icmp = False
+        self._warned_drop = False
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -92,11 +99,23 @@ class UDPNetwork:
 
     # -- inbound pipeline ---------------------------------------------------
 
+    def _icmp_error(self, exc) -> None:
+        self.icmp_errors += 1
+        if not self._warned_icmp:  # warn once; a dead peer fires thousands
+            self._warned_icmp = True
+            self.log.warn("udp_icmp", f"{self.listen_addr}: {exc}")
+
     def _enqueue(self, data: bytes) -> None:
         try:
             self._queue.put_nowait(data)
         except asyncio.QueueFull:  # drop, like the reference's full channel
             self.dropped += 1
+            if not self._warned_drop:  # warn once; a flooder fills forever
+                self._warned_drop = True
+                self.log.warn(
+                    "udp_queue_full",
+                    f"{self.listen_addr}: dropping inbound datagrams",
+                )
 
     async def _dispatch_loop(self) -> None:
         while True:
@@ -104,6 +123,7 @@ class UDPNetwork:
             try:
                 packet = self.enc.decode(data)
             except Exception as e:  # malformed datagram: count and move on
+                self.decode_errors += 1
                 self.log.warn("udp_decode", e)
                 continue
             self.rcvd += 1
@@ -120,6 +140,8 @@ class UDPNetwork:
             "sentPackets": float(self.sent),
             "rcvdPackets": float(self.rcvd),
             "droppedPackets": float(self.dropped),
+            "icmpErrors": float(self.icmp_errors),
+            "decodeErrors": float(self.decode_errors),
         }
         if hasattr(self.enc, "values"):
             out.update(self.enc.values())

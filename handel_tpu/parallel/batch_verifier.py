@@ -13,11 +13,22 @@ from __future__ import annotations
 
 import asyncio
 from functools import partial
-from typing import Sequence
+from typing import Callable, Sequence
 
 from handel_tpu.core.bitset import BitSet
+from handel_tpu.core.logging import DEFAULT_LOGGER, Logger
 from handel_tpu.core.store import VerifiedAggCache
 from handel_tpu.models.bn254_jax import BN254Device
+from handel_tpu.utils.breaker import CircuitBreaker
+
+__all__ = ["BatchVerifierService", "CircuitBreaker"]
+
+
+# the host fallback contract: (msg, [(global bitset, signature)]) -> verdicts,
+# synchronous (it runs in an executor thread). The natural implementation is
+# the scheme's own host-side serial batch_verify over the registry pubkeys
+# (core/crypto.py Constructor.batch_verify -> ops/bn254_ref math).
+FallbackVerifier = Callable[[bytes, Sequence[tuple[BitSet, object]]], list]
 
 
 class BatchVerifierService:
@@ -41,10 +52,30 @@ class BatchVerifierService:
         max_delay_ms: float = 2.0,
         max_inflight: int = 2,
         dedup_cache: VerifiedAggCache | None = None,
+        fallback: FallbackVerifier | None = None,
+        breaker: CircuitBreaker | None = None,
+        retry_limit: int = 2,
+        backoff_base_s: float = 0.05,
+        backoff_cap_s: float = 1.0,
+        logger: Logger = DEFAULT_LOGGER,
     ):
         self.device = device
         self.max_delay = max_delay_ms / 1000.0
         self.max_inflight = max(1, max_inflight)
+        # -- resilience plane: breaker + host failover ---------------------
+        # transient device errors retry with capped exponential backoff;
+        # persistent ones open the breaker and route batches to `fallback`
+        # (host reference verifier) so a dead accelerator degrades
+        # throughput instead of stalling every node
+        self.fallback = fallback
+        self.breaker = breaker or CircuitBreaker()
+        self.retry_limit = max(0, retry_limit)
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
+        self.log = logger
+        self.device_retries = 0
+        self.failover_batches = 0
+        self.failover_candidates = 0
         self._pending: list[tuple[bytes, BitSet, object, asyncio.Future]] = []
         self._kick = asyncio.Event()
         self._task: asyncio.Task | None = None
@@ -92,7 +123,7 @@ class BatchVerifierService:
         if self._fetch_q is not None:
             while True:
                 try:
-                    _, items = self._fetch_q.get_nowait()
+                    _, _, items = self._fetch_q.get_nowait()
                 except asyncio.QueueEmpty:
                     break
                 for _, _, fut in items:
@@ -192,32 +223,81 @@ class BatchVerifierService:
                 by_msg.setdefault(msg, []).append((bs, sig, fut))
             for msg, items in by_msg.items():
                 reqs = [(bs, sig) for bs, sig, _ in items]
-                loop = asyncio.get_running_loop()
-                try:
+                handle = None
+                if self.breaker.allow():
                     # dispatch only (host prep + async enqueue) — the fetch
                     # stage blocks on the verdicts so this loop can already
-                    # build and dispatch the next launch
-                    handle = await loop.run_in_executor(
-                        None, partial(self.device.dispatch, msg, reqs)
-                    )
-                except asyncio.CancelledError:
-                    raise  # stop() fails the futures via _collecting
-                except Exception as e:
-                    for _, _, fut in items:
-                        if not fut.done():
-                            fut.set_exception(
-                                RuntimeError(f"batch verifier: {e}")
-                            )
+                    # build and dispatch the next launch. Transient errors
+                    # retry with capped exponential backoff; each failure
+                    # feeds the breaker.
+                    handle = await self._dispatch_with_retries(msg, reqs)
+                if handle is None:
+                    # breaker open, or retries exhausted: host failover
+                    # (or fail the futures when no fallback exists)
+                    await self._failover(msg, items)
                     continue
-                await self._fetch_q.put((handle, items))
+                await self._fetch_q.put((handle, msg, items))
             self._collecting = None
+
+    async def _dispatch_with_retries(self, msg, reqs):
+        """Try the device up to 1 + retry_limit times; None = gave up."""
+        loop = asyncio.get_running_loop()
+        for attempt in range(1 + self.retry_limit):
+            try:
+                return await loop.run_in_executor(
+                    None, partial(self.device.dispatch, msg, reqs)
+                )
+            except asyncio.CancelledError:
+                raise  # stop() fails the futures via _collecting
+            except Exception as e:
+                self.breaker.record_failure()
+                self.log.warn(
+                    "verifier_device_error",
+                    f"dispatch attempt {attempt + 1}: {e}",
+                )
+                if not self.breaker.allow() or attempt >= self.retry_limit:
+                    return None
+                self.device_retries += 1
+                await asyncio.sleep(
+                    min(self.backoff_base_s * 2**attempt, self.backoff_cap_s)
+                )
+        return None
+
+    async def _failover(self, msg, items) -> None:
+        """Resolve a batch through the host reference verifier; with no
+        fallback configured, fail the futures (BatchProcessing requeues the
+        candidates under its retry budget — the pre-breaker behavior)."""
+        if self.fallback is None:
+            err = RuntimeError("batch verifier: device unavailable")
+            for _, _, fut in items:
+                if not fut.done():
+                    fut.set_exception(err)
+            return
+        reqs = [(bs, sig) for bs, sig, _ in items]
+        loop = asyncio.get_running_loop()
+        try:
+            verdicts = await loop.run_in_executor(
+                None, partial(self.fallback, msg, reqs)
+            )
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            for _, _, fut in items:
+                if not fut.done():
+                    fut.set_exception(RuntimeError(f"batch verifier: {e}"))
+            return
+        self.failover_batches += 1
+        self.failover_candidates += len(items)
+        for (_, _, fut), ok in zip(items, verdicts):
+            if not fut.done():
+                fut.set_result(bool(ok))
 
     async def _fetcher(self) -> None:
         """Second pipeline stage: pull verdicts for dispatched launches, in
         dispatch order, and resolve the waiters."""
         loop = asyncio.get_running_loop()
         while True:
-            handle, items = await self._fetch_q.get()
+            handle, msg, items = await self._fetch_q.get()
             # outside _fetch_q until resolved: visible to stop() (see
             # _collector's mirror note)
             self._fetching = items
@@ -228,11 +308,14 @@ class BatchVerifierService:
             except asyncio.CancelledError:
                 raise  # stop() fails the futures via _fetching
             except Exception as e:
-                for _, _, fut in items:
-                    if not fut.done():
-                        fut.set_exception(RuntimeError(f"batch verifier: {e}"))
+                # a fetch-side device death (verdict transfer failed) takes
+                # the same breaker + host-failover path as dispatch errors
+                self.breaker.record_failure()
+                self.log.warn("verifier_device_error", f"fetch: {e}")
+                await self._failover(msg, items)
                 self._fetching = None
                 continue
+            self.breaker.record_success()
             self.launches += 1
             self.candidates += len(items)
             for (_, _, fut), ok in zip(items, verdicts):
@@ -255,6 +338,14 @@ class BatchVerifierService:
             "hostPackLaunches": float(
                 getattr(self.device, "host_pack_launches", 0)
             ),
+            # resilience plane: breaker + host-failover counters
+            "breakerState": {"closed": 0.0, "half-open": 0.5, "open": 1.0}[
+                self.breaker.state
+            ],
+            "breakerOpenCt": float(self.breaker.open_count),
+            "deviceRetryCt": float(self.device_retries),
+            "failoverBatches": float(self.failover_batches),
+            "failoverCandidates": float(self.failover_candidates),
             # process-wide dedup plane (monitor keys: verifier_dedup*)
             **self.cache.values(),
         }

@@ -23,6 +23,7 @@ except ModuleNotFoundError:  # Python < 3.11: the tomli backport is the
 from dataclasses import dataclass, field
 
 from handel_tpu.core.config import Config
+from handel_tpu.network.chaos import ChaosConfig
 
 
 @dataclass
@@ -60,12 +61,34 @@ class HandelParams:
 
 
 @dataclass
+class AdversaryParams:
+    """Byzantine roles per run (sim/adversary.py): how many nodes play each
+    role, assigned deterministically to the highest non-offline ids."""
+
+    invalid_signer: int = 0
+    stale_replayer: int = 0
+    flooder: int = 0
+    flood_pps: float = 200.0
+
+    def total(self) -> int:
+        return self.invalid_signer + self.stale_replayer + self.flooder
+
+    def counts(self) -> dict[str, int]:
+        return {
+            "invalid_signer": self.invalid_signer,
+            "stale_replayer": self.stale_replayer,
+            "flooder": self.flooder,
+        }
+
+
+@dataclass
 class RunConfig:
     nodes: int = 8
     threshold: int = 0  # 0 -> default percentage
     failing: int = 0
     processes: int = 1
     handel: HandelParams = field(default_factory=HandelParams)
+    adversaries: AdversaryParams = field(default_factory=AdversaryParams)
 
     def resolved_threshold(self) -> int:
         if self.threshold > 0:
@@ -86,6 +109,7 @@ class RunConfig:
             "nodes": float(self.nodes),
             "threshold": float(self.resolved_threshold()),
             "failing": float(self.failing),
+            "adversaries": float(self.adversaries.total()),
             "period_ms": float(self.handel.period_ms),
             "timeout_ms": float(self.handel.timeout_ms),
             "update_count": float(self.handel.update_count),
@@ -125,6 +149,9 @@ class SimConfig:
     # "" = Handel; "nsquare" / "gossipsub" select the comparison baselines
     # (simul/p2p; here handel_tpu/baselines/gossip.py)
     baseline: str = ""
+    # -- fault injection (network/chaos.py): applied to every node's
+    # transport when any rate is nonzero; seeds derive per node ------------
+    chaos: ChaosConfig = field(default_factory=ChaosConfig)
     # -- remote platform (sim/remote.py; aws.go analog) --------------------
     hosts: list[HostSpec] = field(default_factory=list)
     master_ip: str = "127.0.0.1"  # address remote nodes dial the master at
@@ -150,6 +177,17 @@ def load_config(path: str) -> SimConfig:
         master_ip=str(raw.get("master_ip", "127.0.0.1")),
         base_port=int(raw.get("base_port", 0)),
     )
+    ch = raw.get("chaos", {})
+    cfg.chaos = ChaosConfig(
+        drop_rate=float(ch.get("drop_rate", 0.0)),
+        corrupt_rate=float(ch.get("corrupt_rate", 0.0)),
+        duplicate_rate=float(ch.get("duplicate_rate", 0.0)),
+        reorder_rate=float(ch.get("reorder_rate", 0.0)),
+        delay_rate=float(ch.get("delay_rate", 0.0)),
+        delay_ms=float(ch.get("delay_ms", 0.0)),
+        delay_jitter_ms=float(ch.get("delay_jitter_ms", 0.0)),
+        seed=int(ch.get("seed", 0)),
+    ).validate()
     for h in raw.get("hosts", []):
         cfg.hosts.append(
             HostSpec(
@@ -162,12 +200,19 @@ def load_config(path: str) -> SimConfig:
         )
     for r in raw.get("runs", []):
         h = r.get("handel", {})
+        a = r.get("adversaries", {})
         cfg.runs.append(
             RunConfig(
                 nodes=int(r.get("nodes", 8)),
                 threshold=int(r.get("threshold", 0)),
                 failing=int(r.get("failing", 0)),
                 processes=int(r.get("processes", 1)),
+                adversaries=AdversaryParams(
+                    invalid_signer=int(a.get("invalid_signer", 0)),
+                    stale_replayer=int(a.get("stale_replayer", 0)),
+                    flooder=int(a.get("flooder", 0)),
+                    flood_pps=float(a.get("flood_pps", 200.0)),
+                ),
                 handel=HandelParams(
                     period_ms=float(h.get("period_ms", 10.0)),
                     update_count=int(h.get("update_count", 1)),
@@ -200,6 +245,19 @@ def dump_config(cfg: SimConfig) -> str:
         f'master_ip = "{cfg.master_ip}"',
         f"base_port = {cfg.base_port}",
     ]
+    if cfg.chaos.any():
+        lines += [
+            "",
+            "[chaos]",
+            f"drop_rate = {cfg.chaos.drop_rate}",
+            f"corrupt_rate = {cfg.chaos.corrupt_rate}",
+            f"duplicate_rate = {cfg.chaos.duplicate_rate}",
+            f"reorder_rate = {cfg.chaos.reorder_rate}",
+            f"delay_rate = {cfg.chaos.delay_rate}",
+            f"delay_ms = {cfg.chaos.delay_ms}",
+            f"delay_jitter_ms = {cfg.chaos.delay_jitter_ms}",
+            f"seed = {cfg.chaos.seed}",
+        ]
     for h in cfg.hosts:
         lines += [
             "",
@@ -218,6 +276,16 @@ def dump_config(cfg: SimConfig) -> str:
             f"threshold = {r.threshold}",
             f"failing = {r.failing}",
             f"processes = {r.processes}",
+        ]
+        if r.adversaries.total():
+            lines += [
+                "[runs.adversaries]",
+                f"invalid_signer = {r.adversaries.invalid_signer}",
+                f"stale_replayer = {r.adversaries.stale_replayer}",
+                f"flooder = {r.adversaries.flooder}",
+                f"flood_pps = {r.adversaries.flood_pps}",
+            ]
+        lines += [
             "[runs.handel]",
             f"period_ms = {r.handel.period_ms}",
             f"update_count = {r.handel.update_count}",

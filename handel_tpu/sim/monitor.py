@@ -72,12 +72,13 @@ class CounterIO:
     reporter's counters at construction, record the difference.
 
     Keys ending in a GAUGE_SUFFIX are point-in-time ratios or levels (hit
-    rates, launch occupancy, cache sizes — e.g. the dedup plane's
-    `dedupHitRate`/`dedupSize`, core/store.py VerifiedAggCache.values):
+    rates, launch occupancy, cache sizes, breaker state — e.g. the dedup
+    plane's `dedupHitRate`/`dedupSize`, core/store.py VerifiedAggCache.values,
+    and the verifier breaker's `breakerState`, parallel/batch_verifier.py):
     `now - base` is meaningless for a ratio whenever the construction-time
     snapshot is nonzero, so those are recorded as-is."""
 
-    GAUGE_SUFFIXES = ("Rate", "Occupancy", "Size")
+    GAUGE_SUFFIXES = ("Rate", "Occupancy", "Size", "State")
 
     def __init__(self, sink: Sink, name: str, reporter):
         self.sink = sink

@@ -18,14 +18,21 @@ import argparse
 import asyncio
 import sys
 
-from handel_tpu.core.crypto import verify_multisignature
+from handel_tpu.core.crypto import Constructor, verify_multisignature
 from handel_tpu.core.handel import Handel
 from handel_tpu.models.registry import is_device_scheme, new_scheme
+from handel_tpu.network.chaos import ChaosNetwork
 from handel_tpu.network.encoding import CounterEncoding
 from handel_tpu.network.udp import UDPNetwork
 from handel_tpu.network.tcp import TCPNetwork
 from handel_tpu.network.quic import QUICNetwork
 from handel_tpu.sim import keys as simkeys
+from handel_tpu.sim.adversary import (
+    adversary_roles,
+    build_adversary,
+    check_threshold_reachable,
+)
+from handel_tpu.sim.allocator import new_allocator
 from handel_tpu.sim.config import load_config
 from handel_tpu.sim.monitor import CounterIO, Sink, TimeMeasure
 from handel_tpu.sim.sync import STATE_END, STATE_START, SyncSlave
@@ -73,6 +80,17 @@ async def run_node_process(args) -> int:
     records = simkeys.read_registry_csv(args.registry)
     registry = simkeys.registry_from_records(records, scheme)
 
+    # byzantine roles (sim/adversary.py): recompute the allocator's offline
+    # set locally so every process derives the SAME id -> role mapping
+    roles: dict[int, str] = {}
+    if run.adversaries.total():
+        alloc = new_allocator(cfg.allocator).allocate(
+            run.nodes, 1, run.processes, run.failing
+        )
+        offline = {nid for nid, slot in alloc.items() if not slot.active}
+        roles = adversary_roles(run.adversaries.counts(), run.nodes, offline)
+        check_threshold_reachable(threshold, run.nodes, run.failing, roles)
+
     # one transport per logical node, bound to its registry address
     nets, handels = [], []
     shared_service = None
@@ -109,7 +127,16 @@ async def run_node_process(args) -> int:
         device.fetch = launch_timer
         dispatch_timer = KernelTimer(device.dispatch, name="dispatch")
         device.dispatch = dispatch_timer
-        shared_service = BatchVerifierService(device)
+        # host failover target for the verifier circuit breaker: the
+        # scheme's inherited host-side serial batch_verify (aggregate the
+        # registry pubkey objects + one reference pairing check per
+        # candidate) — a dead device degrades throughput, not the run
+        pubkeys = registry.public_keys()
+
+        def host_fallback(msg, reqs, _c=scheme.constructor, _pk=pubkeys):
+            return Constructor.batch_verify(_c, msg, _pk, reqs)
+
+        shared_service = BatchVerifierService(device, fallback=host_fallback)
         if plane is not None:
             plane.add("verifier", shared_service)
             plane.add("launch", launch_timer)
@@ -138,6 +165,10 @@ async def run_node_process(args) -> int:
             net = QUICNetwork(rec.address, encoding=enc)
         else:
             net = UDPNetwork(rec.address, encoding=enc)
+        if cfg.chaos.any():
+            # fault-injection plane (network/chaos.py): same transport
+            # underneath, seeded per-link faults on top
+            net = ChaosNetwork(net, cfg.chaos.for_node(nid))
         await net.start()
         nets.append(net)
         sk = simkeys.secret_of(rec, scheme)
@@ -167,15 +198,28 @@ async def run_node_process(args) -> int:
                 hconf.verifier = shared_service.verify
             elif rpc_client is not None:
                 hconf.verifier = rpc_client.verify
-            h = Handel(
-                net,
-                registry,
-                registry.identity(nid),
-                scheme.constructor,
-                MSG,
-                sk.sign(MSG),
-                hconf,
-            )
+            if nid in roles:
+                h = build_adversary(
+                    roles[nid],
+                    net,
+                    registry,
+                    registry.identity(nid),
+                    scheme.constructor,
+                    MSG,
+                    sk,
+                    hconf,
+                    flood_pps=run.adversaries.flood_pps,
+                )
+            else:
+                h = Handel(
+                    net,
+                    registry,
+                    registry.identity(nid),
+                    scheme.constructor,
+                    MSG,
+                    sk.sign(MSG),
+                    hconf,
+                )
         handels.append((nid, h, net))
 
     # barrier: ready to start (one slave per logical node id)
@@ -191,9 +235,10 @@ async def run_node_process(args) -> int:
     measures = []
     for nid, h, net in handels:
         if sink:
-            sig_counters = h.proc if hasattr(h, "proc") else h  # gossip: self
+            # Handel.values() now carries the whole per-node plane —
+            # processing + store + penalty counters; gossip reports itself
             ms = [TimeMeasure(sink, "sigen"), CounterIO(sink, "net", net),
-                  CounterIO(sink, "sigs", sig_counters)]
+                  CounterIO(sink, "sigs", h)]
             measures.append(tuple(ms))
         else:
             measures.append(None)
@@ -204,9 +249,16 @@ async def run_node_process(args) -> int:
             return await h.final_signatures.get()
         return await h.final  # gossip baseline
 
+    # adversarial nodes never emit an honest final signature — only the
+    # honest cohort gates run completion
+    honest = [
+        (nid, h, net)
+        for nid, h, net in handels
+        if getattr(h, "role", None) is None
+    ]
     try:
         finals = await asyncio.wait_for(
-            asyncio.gather(*(one_done(h) for _, h, _ in handels)),
+            asyncio.gather(*(one_done(h) for _, h, _ in honest)),
             timeout=cfg.max_timeout_s,
         )
     except asyncio.TimeoutError:
@@ -225,11 +277,15 @@ async def run_node_process(args) -> int:
         raise
 
     ok = True
-    for (nid, h, net), ms, m in zip(handels, finals, measures):
+    finals_by_nid = dict(zip((nid for nid, _, _ in honest), finals))
+    for (nid, h, net), m in zip(handels, measures):
         if m:
             for meas in m:
                 meas.record()
-        if not verify_multisignature(MSG, ms, registry, scheme.constructor):
+        ms = finals_by_nid.get(nid)
+        if ms is not None and not verify_multisignature(
+            MSG, ms, registry, scheme.constructor
+        ):
             print(f"node {nid}: FINAL SIGNATURE INVALID", file=sys.stderr)
             ok = False
         h.stop()

@@ -1,0 +1,62 @@
+"""Circuit breaker for accelerator-health gating.
+
+Lives in utils so both consumers can import it without a cycle: the shared
+batch-verifier service (parallel/batch_verifier.py, which imports the
+device) and the device constructor itself (models/bn254_jax.py, which the
+service imports).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+
+class CircuitBreaker:
+    """Device-health gate: closed → (N consecutive failures) → open →
+    (cooldown elapses) → half-open probe → closed on success.
+
+    A dead accelerator (device lost, XLA runtime error, tunnel down) would
+    otherwise fail EVERY batch after a full dispatch attempt; once the
+    breaker opens, batches skip the device entirely and take the host
+    fallback until one probe launch after the cooldown proves it back.
+    """
+
+    def __init__(
+        self,
+        threshold: int = 3,
+        cooldown_s: float = 5.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if threshold < 1:
+            raise ValueError("breaker threshold must be >= 1")
+        self.threshold = threshold
+        self.cooldown_s = cooldown_s
+        self.clock = clock
+        self.failures = 0  # consecutive
+        self.opened_at: float | None = None
+        self.open_count = 0
+
+    def allow(self) -> bool:
+        """May the next batch try the device? True while closed, and for
+        the half-open probe once the cooldown has elapsed."""
+        if self.opened_at is None:
+            return True
+        return self.clock() - self.opened_at >= self.cooldown_s
+
+    def record_success(self) -> None:
+        self.failures = 0
+        self.opened_at = None
+
+    def record_failure(self) -> None:
+        self.failures += 1
+        if self.failures >= self.threshold:
+            if self.opened_at is None:
+                self.open_count += 1  # closed -> open transition only
+            self.opened_at = self.clock()  # (re)start the cooldown
+
+    @property
+    def state(self) -> str:
+        if self.opened_at is None:
+            return "closed"
+        return "half-open" if self.allow() else "open"

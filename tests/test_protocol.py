@@ -190,6 +190,72 @@ def test_requeue_retry_cap():
     run(go())
 
 
+def test_requeue_does_not_starve_queue():
+    """A candidate whose batches keep erroring exhausts its retry budget and
+    is dropped — while OTHER candidates behind it still get verified (the
+    retry cap exists precisely so one poisoned candidate cannot pin the
+    queue forever)."""
+    from handel_tpu.core.bitset import BitSet
+    from handel_tpu.core.crypto import MultiSignature
+    from handel_tpu.core.identity import ArrayRegistry, Identity
+    from handel_tpu.core.partitioner import BinomialPartitioner, IncomingSig
+    from handel_tpu.core.processing import BatchProcessing
+    from handel_tpu.models.fake import FakePublic, FakeSignature
+
+    async def go():
+        reg = ArrayRegistry(
+            [Identity(i, f"x-{i}", FakePublic(True)) for i in range(8)]
+        )
+        part = BinomialPartitioner(0, reg)
+        # poisoned is scored highest so it hogs the front of the queue
+        scores = {1: 10, 2: 5, 3: 4}
+        verified = []
+        poison = FakeSignature()
+
+        class Eval:
+            def evaluate(self, sp):
+                return scores[sp.origin]
+
+        async def poisoned_verifier(msg, pubkeys, requests):
+            # the device "errors" on any batch carrying the poisoned sig
+            if any(sig is poison for _, sig in requests):
+                raise RuntimeError("device chokes on this candidate")
+            return [True] * len(requests)
+
+        proc = BatchProcessing(
+            part,
+            FakeConstructor(),
+            b"m",
+            [None] * 8,
+            Eval(),
+            lambda sp: verified.append(sp.origin),
+            batch_size=1,  # poisoned candidate rides alone
+            verifier=poisoned_verifier,
+        )
+        proc.start()
+        sps = {}
+        for origin in (1, 2, 3):
+            bs = BitSet(1)
+            bs.set(0)
+            sig = poison if origin == 1 else FakeSignature()
+            sps[origin] = IncomingSig(
+                origin=origin, level=1, ms=MultiSignature(bs, sig)
+            )
+            proc.add(sps[origin])
+        for _ in range(100):
+            await asyncio.sleep(0.01)
+            if len(verified) >= 2 and sps[1].verify_tries > proc.max_retries:
+                break
+        proc.stop()
+        # the healthy candidates completed despite the poisoned front-runner
+        assert sorted(verified) == [2, 3]
+        # and the poisoned one was dropped after its retry budget
+        assert sps[1].verify_tries == proc.max_retries + 1
+        assert all(s.origin != 1 for s in proc.pending())
+
+    run(go())
+
+
 def test_heap_priority_and_lazy_suppression():
     """The priority queue verifies higher-scored candidates first and a
     candidate whose score drops to 0 after enqueue is pruned at dequeue
