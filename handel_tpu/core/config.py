@@ -82,6 +82,13 @@ class Config:
     # of growing it (core/processing.py)
     max_pending: int = DEFAULT_MAX_PENDING
 
+    # -- observability (core/trace.py) -------------------------------------
+    # span flight recorder following every contribution recv -> queue ->
+    # verify -> merge; None disables tracing (the hooks cost one None check
+    # per contribution). Shared across co-located nodes — each node records
+    # under its own id as the Chrome-trace tid.
+    recorder: Optional[object] = None
+
     # -- TPU batch plane ---------------------------------------------------
     # max candidates per device verification launch
     batch_size: int = DEFAULT_BATCH_SIZE

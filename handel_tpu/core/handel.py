@@ -29,8 +29,10 @@ from handel_tpu.core.penalty import (
     PeerScorer,
 )
 from handel_tpu.core.processing import BatchProcessing
+from handel_tpu.core.report import WarnOnce
 from handel_tpu.core.store import SignatureStore
 from handel_tpu.core.timeout import LinearTimeout
+from handel_tpu.core.trace import LogHistogram, trace_now
 
 
 class Level:
@@ -189,6 +191,18 @@ class Handel:
         self.final_signatures: asyncio.Queue[MultiSignature] = asyncio.Queue()
         self.start_time = 0.0
 
+        # span flight recorder (core/trace.py): shared across co-located
+        # nodes, this node's events keyed by its id as the Chrome-trace tid.
+        # None = tracing off; the hot-path hooks cost one None check.
+        self.rec = self.c.recorder
+        self._tid = identity.id
+        if self.rec is not None:
+            self.rec.name_thread(self._tid, f"node-{identity.id}")
+        # distributional measures (always on — a handful of clock reads per
+        # level/batch): level-completion latency since start, for the
+        # monitor plane's _p50/_p90/_p99 columns (sim/monitor.py)
+        self.hist_level_complete = LogHistogram()
+
         self.store = SignatureStore(self.partitioner, self.c.new_bitset, constructor)
         # our own signature seeds the store at level 0 (handel.go:108-116)
         first_bs = self.c.new_bitset(1)
@@ -224,6 +238,8 @@ class Handel:
             max_pending=self.c.max_pending,
             on_verify_failed=self._on_verify_failed,
             logger=self.log,
+            recorder=self.rec,
+            trace_tid=self._tid,
         )
         self.net.register_listener(self)
         self.timeout = (
@@ -239,8 +255,8 @@ class Handel:
         self.banned_packet_ct = 0
         # warn-once log keys: a flooder spamming malformed packets must not
         # turn the log itself into the DoS — first offense per reason is
-        # WARN, the rest are debug + counters
-        self._warned: set[str] = set()
+        # WARN, the rest are debug + the logWarnCt counter (core/report.py)
+        self._warn = WarnOnce(self.log)
         self._periodic_task: asyncio.Task | None = None
 
     # -- lifecycle (handel.go:156-182) -------------------------------------
@@ -279,6 +295,9 @@ class Handel:
     def new_packet(self, p: Packet) -> None:
         if self.done:
             return
+        rec = self.rec
+        tracing = rec is not None and rec.enabled
+        t0 = trace_now() if tracing else 0.0
         try:
             self._validate_packet(p)
         except ValueError as e:
@@ -295,19 +314,47 @@ class Handel:
             if self.scorer is not None:
                 self.scorer.report(p.origin, WEIGHT_PARSE_FAIL)
             return
+        if tracing:
+            # the sender's stamp lines the network-transit span up with our
+            # local spans (both sides use the shared epoch trace clock)
+            if p.sent_ts and p.sent_ts <= t0:
+                rec.span(
+                    "net_transit",
+                    p.sent_ts,
+                    t0,
+                    tid=self._tid,
+                    cat="net",
+                    args={"origin": p.origin, "level": p.level},
+                )
+            ms.recv_ts = t0
+            if ind is not None:
+                ind.recv_ts = t0
         if not self.levels[p.level].rcv_completed:
             self.proc.add(ms)
             if ind is not None:
                 self.proc.add(ind)
+            if tracing:
+                # `rts` (arrival stamp, µs) discriminates re-deliveries of
+                # the same (origin, level) so the trace CLI reconstructs
+                # each physical contribution's chain separately
+                rec.span(
+                    "recv",
+                    t0,
+                    trace_now(),
+                    tid=self._tid,
+                    cat="pipeline",
+                    args={
+                        "origin": p.origin,
+                        "level": p.level,
+                        "rts": int(t0 * 1e6),
+                    },
+                )
 
     def _warn_once(self, key: str, detail) -> None:
-        """WARN on the first occurrence per reason, debug after — a flooder
-        cannot turn per-packet logging into the attack."""
-        if key not in self._warned:
-            self._warned.add(key)
-            self.log.warn(key, detail)
-        else:
-            self.log.debug(key, detail)
+        """WARN on the first occurrence per reason, debug + counter after —
+        a flooder cannot turn per-packet logging into the attack, and the
+        suppressed volume stays visible as `logWarnCt` in the CSVs."""
+        self._warn.warn(key, detail)
 
     def _validate_packet(self, p: Packet) -> None:
         """Origin/level range + byzantine checks (handel.go:373-386), all
@@ -359,6 +406,26 @@ class Handel:
     def _on_verified(self, sp: IncomingSig) -> None:
         """Store the verified signature, then run the actors
         (rangeOnVerified, handel.go:239-248)."""
+        rec = self.rec
+        if rec is not None and rec.enabled:
+            t0 = trace_now()
+            self.store.store(sp)
+            self._check_completed_level(sp)
+            self._check_final_signature(sp)
+            rec.span(
+                "merge",
+                t0,
+                trace_now(),
+                tid=self._tid,
+                cat="pipeline",
+                args={
+                    "origin": sp.origin,
+                    "level": sp.level,
+                    "rts": int(sp.recv_ts * 1e6),
+                    "ind": sp.is_ind,
+                },
+            )
+            return
         self.store.store(sp)
         self._check_completed_level(sp)
         self._check_final_signature(sp)
@@ -397,6 +464,16 @@ class Handel:
             if best is not None and best.cardinality() == len(lvl.nodes):
                 self.log.debug("level_complete", sp.level)
                 lvl.rcv_completed = True
+                # tail-visible completion latency: since node start, on the
+                # mergeable histogram plane (p50/p90/p99 CSV columns)
+                self.hist_level_complete.add(time.monotonic() - self.start_time)
+                if self.rec is not None:
+                    self.rec.instant(
+                        "level_complete",
+                        tid=self._tid,
+                        cat="protocol",
+                        args={"level": sp.level},
+                    )
 
         for lid, up in self.levels.items():
             if lid < sp.level + 1:
@@ -441,6 +518,9 @@ class Handel:
             level=level,
             multisig=ms.marshal(),
             individual_sig=ind.marshal() if ind is not None else None,
+            # always stamped (one clock read per send): a traced RECEIVER
+            # can line up cross-node transit spans even when we don't trace
+            sent_ts=trace_now(),
         )
         self.net.send(ids, p)
 
@@ -452,6 +532,7 @@ class Handel:
             "msgRcvCt": float(self.msg_rcv_ct),
             "invalidPacketCt": float(self.invalid_packet_ct),
             "bannedPacketCt": float(self.banned_packet_ct),
+            **self._warn.values(),
             **self.proc.values(),
             **self.store.values(),
         }
@@ -464,3 +545,11 @@ class Handel:
                 sum(lvl.demote_skips for lvl in self.levels.values())
             )
         return out
+
+    def histograms(self) -> dict[str, LogHistogram]:
+        """Distribution measures for the monitor's histogram plane
+        (sim/monitor.py HistogramIO -> `_p50/_p90/_p99` CSV columns)."""
+        return {
+            "levelCompleteS": self.hist_level_complete,
+            **self.proc.histograms(),
+        }

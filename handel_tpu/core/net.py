@@ -10,6 +10,7 @@ language-neutral, and cheap to parse. Transports live in handel_tpu/network/.
 
 from __future__ import annotations
 
+import math
 import struct
 from dataclasses import dataclass
 from typing import Protocol, Sequence, runtime_checkable
@@ -19,19 +20,29 @@ from handel_tpu.core.identity import Identity
 
 @dataclass
 class Packet:
-    """One protocol datagram (net.go:24-44)."""
+    """One protocol datagram (net.go:24-44).
+
+    `sent_ts` is the sender's epoch-seconds send timestamp (core/trace.py
+    trace clock). Processes on one host share the clock, so a receiving
+    node's flight recorder can emit the network-transit span of every
+    contribution; 0.0 means "not stamped".
+    """
 
     origin: int  # global id of the sender
     level: int  # level this packet's multisig belongs to
     multisig: bytes  # marshaled MultiSignature
     individual_sig: bytes | None = None  # optional marshaled individual sig
+    sent_ts: float = 0.0  # sender trace-clock timestamp (0 = unstamped)
 
-    _HDR = struct.Struct(">iBHH")  # origin, level, len(multisig), len(indiv)
+    # origin, level, len(multisig), len(indiv), sent_ts
+    _HDR = struct.Struct(">iBHHd")
 
     def encode(self) -> bytes:
         ind = self.individual_sig or b""
         return (
-            self._HDR.pack(self.origin, self.level, len(self.multisig), len(ind))
+            self._HDR.pack(
+                self.origin, self.level, len(self.multisig), len(ind), self.sent_ts
+            )
             + self.multisig
             + ind
         )
@@ -40,13 +51,21 @@ class Packet:
     def decode(cls, data: bytes) -> "Packet":
         if len(data) < cls._HDR.size:
             raise ValueError("packet too short")
-        origin, level, ms_len, ind_len = cls._HDR.unpack_from(data)
+        origin, level, ms_len, ind_len, sent_ts = cls._HDR.unpack_from(data)
         off = cls._HDR.size
         if len(data) < off + ms_len + ind_len:
             raise ValueError("packet truncated")
         ms = data[off : off + ms_len]
         ind = data[off + ms_len : off + ms_len + ind_len] if ind_len else None
-        return cls(origin=origin, level=level, multisig=ms, individual_sig=ind)
+        if not math.isfinite(sent_ts) or sent_ts < 0.0:
+            sent_ts = 0.0  # corrupt stamps degrade to "unstamped", never NaN
+        return cls(
+            origin=origin,
+            level=level,
+            multisig=ms,
+            individual_sig=ind,
+            sent_ts=sent_ts,
+        )
 
 
 @runtime_checkable

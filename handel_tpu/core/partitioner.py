@@ -43,6 +43,10 @@ class IncomingSig:
     is_ind: bool = False
     mapped_index: int = 0
     verify_tries: int = 0  # verifier-error retry count (processing requeue)
+    # trace stamps (core/trace.py clock): packet arrival and (re)enqueue
+    # into the pending queue — the span boundaries of recv/queue/verify
+    recv_ts: float = 0.0
+    enqueue_ts: float = 0.0
 
     @property
     def individual(self) -> bool:

@@ -31,6 +31,7 @@ from handel_tpu.core.crypto import Constructor, PublicKey, Signature
 from handel_tpu.core.logging import DEFAULT_LOGGER, Logger
 from handel_tpu.core.partitioner import BinomialPartitioner, IncomingSig
 from handel_tpu.core.store import VerifiedAggCache
+from handel_tpu.core.trace import LogHistogram, trace_now
 
 
 class SigEvaluator(Protocol):
@@ -104,6 +105,8 @@ class BatchProcessing:
         max_pending: int = 4096,
         on_verify_failed: Callable[[IncomingSig], None] | None = None,
         logger: Logger = DEFAULT_LOGGER,
+        recorder=None,
+        trace_tid: int = 0,
     ):
         self.part = part
         self.cons = constructor
@@ -141,6 +144,14 @@ class BatchProcessing:
         self._wakeup = asyncio.Event()
         self._task: asyncio.Task | None = None
         self._stopped = False
+
+        # observability plane (core/trace.py): per-contribution queue/verify
+        # spans when a flight recorder is attached, plus always-on latency
+        # histograms (one clock read per enqueue/batch — negligible)
+        self.rec = recorder
+        self.tid = trace_tid
+        self.hist_queue_wait = LogHistogram()  # enqueue -> selected, per sig
+        self.hist_verify = LogHistogram()  # verifier wall, per batch
 
         # reporter counters (processing.go:242-256)
         self.sig_checked_ct = 0
@@ -185,6 +196,7 @@ class BatchProcessing:
         if mark <= 0:
             self.sig_suppressed += 1
             return
+        sp.enqueue_ts = trace_now()  # queue-wait span start (re-stamped on requeue)
         self._seq += 1
         heapq.heappush(self._heap, (-mark, self._seq, sp))
         self._live[self._seq] = sp
@@ -282,6 +294,27 @@ class BatchProcessing:
 
     async def _verify_and_publish(self, batch: list[IncomingSig]) -> None:
         start = time.perf_counter()
+        rec = self.rec
+        tracing = rec is not None and rec.enabled
+        t_deq = trace_now()
+        for sp in batch:
+            if sp.enqueue_ts:
+                self.hist_queue_wait.add(max(0.0, t_deq - sp.enqueue_ts))
+                if tracing:
+                    rec.span(
+                        "queue",
+                        sp.enqueue_ts,
+                        t_deq,
+                        tid=self.tid,
+                        cat="pipeline",
+                        args={
+                            "origin": sp.origin,
+                            "level": sp.level,
+                            "rts": int(sp.recv_ts * 1e6),
+                            "ind": sp.is_ind,
+                            "tries": sp.verify_tries,
+                        },
+                    )
         # Dedup pass: a candidate whose exact content — (level, bitset words,
         # signature bytes) — this node has already judged takes its remembered
         # verdict; duplicates WITHIN the batch ride the first copy's lane.
@@ -346,6 +379,29 @@ class BatchProcessing:
             if oks[i] is None and first_at.get(k, i) != i:
                 oks[i] = oks[first_at[k]]
         self.sig_checking_time_ms += (time.perf_counter() - start) * 1000.0
+        t_verified = trace_now()
+        if to_verify:
+            # device-verify latency per launch — the histogram behind the
+            # CSV's verifyLatencyS_p50/_p90/_p99 columns
+            self.hist_verify.add(max(0.0, t_verified - t_deq))
+        if tracing:
+            for sp, ok in zip(batch, oks):
+                # dedup-cached candidates resolve at the scan: near-zero span
+                rec.span(
+                    "verify",
+                    t_deq,
+                    t_verified,
+                    tid=self.tid,
+                    cat="pipeline",
+                    args={
+                        "origin": sp.origin,
+                        "level": sp.level,
+                        "rts": int(sp.recv_ts * 1e6),
+                        "ind": sp.is_ind,
+                        "ok": bool(ok) if ok is not None else None,
+                        "batch": len(batch),
+                    },
+                )
 
         for sp, ok in zip(batch, oks):
             if ok is None:
@@ -418,6 +474,13 @@ class BatchProcessing:
             **self.dedup.values(),
         }
 
+    def histograms(self) -> dict[str, LogHistogram]:
+        """Latency distributions for the monitor's histogram plane."""
+        return {
+            "queueWaitS": self.hist_queue_wait,
+            "verifyLatencyS": self.hist_verify,
+        }
+
 
 class FifoProcessing(BatchProcessing):
     """Arrival-order pipeline without evaluator scoring
@@ -432,6 +495,7 @@ class FifoProcessing(BatchProcessing):
     """
 
     def _enqueue(self, sp: IncomingSig) -> None:
+        sp.enqueue_ts = trace_now()
         self._todos.append(sp)
         if len(self._todos) > self.max_pending:  # same drop-oldest bound
             self._todos.pop(0)

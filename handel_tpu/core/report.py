@@ -44,6 +44,31 @@ class ReportAggregator:
         return out
 
 
+class WarnOnce:
+    """Warn-once log gate with a reporter-plane counter.
+
+    A flooder spamming malformed packets must not turn per-packet logging
+    into the attack, so repeat offenses per reason drop to debug — but a
+    suppressed warning is invisible in a CSV capture. Every occurrence
+    (warned or suppressed) increments a per-key counter that rides the
+    monitor plane as `logWarnCt` (core/handel.py, network/udp.py)."""
+
+    def __init__(self, logger):
+        self.log = logger
+        self.counts: dict[str, int] = {}
+
+    def warn(self, key: str, detail) -> None:
+        n = self.counts.get(key, 0) + 1
+        self.counts[key] = n
+        (self.log.warn if n == 1 else self.log.debug)(key, detail)
+
+    def total(self) -> int:
+        return sum(self.counts.values())
+
+    def values(self) -> dict[str, float]:
+        return {"logWarnCt": float(self.total())}
+
+
 class KernelTimer:
     """Device launch-time counters for the monitor plane.
 

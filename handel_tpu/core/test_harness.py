@@ -91,6 +91,7 @@ class LocalCluster:
         loss_rate: float = 0.0,
         chaos: ChaosConfig | None = None,
         adversaries: dict[int, str] | None = None,
+        recorder=None,
     ):
         self.n = n
         self.scheme = scheme or FakeScheme()
@@ -119,6 +120,10 @@ class LocalCluster:
             if i in self.offline:
                 continue  # offline nodes are simply never built (test.go:105-113)
             cfg = config_factory(i) if config_factory else Config()
+            if recorder is not None:
+                # shared flight recorder (core/trace.py): all in-process
+                # nodes record into one ring, tid = node id
+                cfg.recorder = recorder
             if threshold is not None:
                 cfg.contributions = threshold
             if cfg.rand is None or config_factory is None:

@@ -20,6 +20,7 @@ from typing import Sequence
 
 from handel_tpu.core.logging import DEFAULT_LOGGER, Logger
 from handel_tpu.core.net import Listener, Packet
+from handel_tpu.core.report import WarnOnce
 from handel_tpu.network.encoding import Encoding, BinaryEncoding
 
 QUEUE_SIZE = 20_000  # inbound buffer slots (udp/net.go:33)
@@ -65,8 +66,9 @@ class UDPNetwork:
         self.dropped = 0  # queue-full drops
         self.icmp_errors = 0  # error_received callbacks (ICMP unreachable)
         self.decode_errors = 0  # malformed datagrams rejected by the codec
-        self._warned_icmp = False
-        self._warned_drop = False
+        # warn-once per reason + the logWarnCt counter (core/report.py): a
+        # dead peer or flooder fires thousands of identical warnings
+        self._warn = WarnOnce(self.log)
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -95,27 +97,23 @@ class UDPNetwork:
                 self._transport.sendto(wire, split_addr(ident.address))
                 self.sent += 1
             except OSError as e:  # unreachable peer: datagrams just vanish
-                self.log.warn("udp_send", f"{ident.address}: {e}")
+                self._warn.warn("udp_send", f"{ident.address}: {e}")
 
     # -- inbound pipeline ---------------------------------------------------
 
     def _icmp_error(self, exc) -> None:
         self.icmp_errors += 1
-        if not self._warned_icmp:  # warn once; a dead peer fires thousands
-            self._warned_icmp = True
-            self.log.warn("udp_icmp", f"{self.listen_addr}: {exc}")
+        self._warn.warn("udp_icmp", f"{self.listen_addr}: {exc}")
 
     def _enqueue(self, data: bytes) -> None:
         try:
             self._queue.put_nowait(data)
         except asyncio.QueueFull:  # drop, like the reference's full channel
             self.dropped += 1
-            if not self._warned_drop:  # warn once; a flooder fills forever
-                self._warned_drop = True
-                self.log.warn(
-                    "udp_queue_full",
-                    f"{self.listen_addr}: dropping inbound datagrams",
-                )
+            self._warn.warn(
+                "udp_queue_full",
+                f"{self.listen_addr}: dropping inbound datagrams",
+            )
 
     async def _dispatch_loop(self) -> None:
         while True:
@@ -124,7 +122,7 @@ class UDPNetwork:
                 packet = self.enc.decode(data)
             except Exception as e:  # malformed datagram: count and move on
                 self.decode_errors += 1
-                self.log.warn("udp_decode", e)
+                self._warn.warn("udp_decode", e)
                 continue
             self.rcvd += 1
             for lst in self.listeners:
@@ -142,6 +140,7 @@ class UDPNetwork:
             "droppedPackets": float(self.dropped),
             "icmpErrors": float(self.icmp_errors),
             "decodeErrors": float(self.decode_errors),
+            **self._warn.values(),
         }
         if hasattr(self.enc, "values"):
             out.update(self.enc.values())

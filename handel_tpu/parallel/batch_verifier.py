@@ -18,6 +18,7 @@ from typing import Callable, Sequence
 from handel_tpu.core.bitset import BitSet
 from handel_tpu.core.logging import DEFAULT_LOGGER, Logger
 from handel_tpu.core.store import VerifiedAggCache
+from handel_tpu.core.trace import SERVICE_TID, trace_now
 from handel_tpu.models.bn254_jax import BN254Device
 from handel_tpu.utils.breaker import CircuitBreaker
 
@@ -58,8 +59,15 @@ class BatchVerifierService:
         backoff_base_s: float = 0.05,
         backoff_cap_s: float = 1.0,
         logger: Logger = DEFAULT_LOGGER,
+        recorder=None,
     ):
         self.device = device
+        # flight recorder (core/trace.py): dispatch-pack (host prep) and
+        # device-verify (launch wall) spans + breaker/failover instants,
+        # recorded on the service's own trace lane (SERVICE_TID)
+        self.rec = recorder
+        if recorder is not None:
+            recorder.name_thread(SERVICE_TID, "batch-verifier")
         self.max_delay = max_delay_ms / 1000.0
         self.max_inflight = max(1, max_inflight)
         # -- resilience plane: breaker + host failover ---------------------
@@ -230,7 +238,19 @@ class BatchVerifierService:
                     # build and dispatch the next launch. Transient errors
                     # retry with capped exponential backoff; each failure
                     # feeds the breaker.
+                    t0 = trace_now()
                     handle = await self._dispatch_with_retries(msg, reqs)
+                    if self.rec is not None and self.rec.enabled:
+                        # the host half of a launch: request packing + the
+                        # async enqueue (PR 1's host_pack_ms lives in here)
+                        self.rec.span(
+                            "dispatch_pack",
+                            t0,
+                            trace_now(),
+                            tid=SERVICE_TID,
+                            cat="verifier",
+                            args={"n": len(reqs), "ok": handle is not None},
+                        )
                 if handle is None:
                     # breaker open, or retries exhausted: host failover
                     # (or fail the futures when no fallback exists)
@@ -251,6 +271,13 @@ class BatchVerifierService:
                 raise  # stop() fails the futures via _collecting
             except Exception as e:
                 self.breaker.record_failure()
+                if self.rec is not None:
+                    self.rec.instant(
+                        "device_error",
+                        tid=SERVICE_TID,
+                        cat="verifier",
+                        args={"stage": "dispatch", "breaker": self.breaker.state},
+                    )
                 self.log.warn(
                     "verifier_device_error",
                     f"dispatch attempt {attempt + 1}: {e}",
@@ -273,6 +300,13 @@ class BatchVerifierService:
                 if not fut.done():
                     fut.set_exception(err)
             return
+        if self.rec is not None:
+            self.rec.instant(
+                "verifier_failover",
+                tid=SERVICE_TID,
+                cat="verifier",
+                args={"n": len(items), "breaker": self.breaker.state},
+            )
         reqs = [(bs, sig) for bs, sig, _ in items]
         loop = asyncio.get_running_loop()
         try:
@@ -301,6 +335,7 @@ class BatchVerifierService:
             # outside _fetch_q until resolved: visible to stop() (see
             # _collector's mirror note)
             self._fetching = items
+            t0 = trace_now()
             try:
                 verdicts = await loop.run_in_executor(
                     None, partial(self.device.fetch, handle)
@@ -315,6 +350,17 @@ class BatchVerifierService:
                 await self._failover(msg, items)
                 self._fetching = None
                 continue
+            if self.rec is not None and self.rec.enabled:
+                # device wall per launch (verdict-arrival latency), the
+                # counterpart of dispatch_pack's host half
+                self.rec.span(
+                    "device_verify",
+                    t0,
+                    trace_now(),
+                    tid=SERVICE_TID,
+                    cat="verifier",
+                    args={"n": len(items)},
+                )
             self.breaker.record_success()
             self.launches += 1
             self.candidates += len(items)

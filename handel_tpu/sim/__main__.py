@@ -4,6 +4,7 @@ Reference: simul/main.go:24-68 — load the TOML config, run each RunConfig
 in order on the chosen platform, abort a run after MaxTimeout.
 
 Usage: python -m handel_tpu.sim --config sim.toml --workdir out/
+       python -m handel_tpu.sim trace <trace-dir>   (analyze a traced run)
 """
 
 from __future__ import annotations
@@ -17,6 +18,12 @@ from handel_tpu.sim.platform import run_simulation
 
 
 def main() -> int:
+    if len(sys.argv) > 1 and sys.argv[1] == "trace":
+        # trace-analysis subcommand (sim/trace_cli.py): reconstruct the
+        # aggregation wave + span attribution from flight-recorder dumps
+        from handel_tpu.sim.trace_cli import main as trace_main
+
+        return trace_main(sys.argv[2:])
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", required=True)
     ap.add_argument("--workdir", default="sim_out")

@@ -146,6 +146,12 @@ class SimConfig:
     # on chip-less hosts virtual CPU devices are forced to this count)
     mesh_devices: int = 1
     debug: bool = False
+    # span tracing (core/trace.py): node processes record a per-contribution
+    # flight recorder and dump Chrome trace_event JSON into the run's
+    # trace dir; analyze with `python -m handel_tpu.sim trace <dir>`
+    trace: bool = False
+    # flight-recorder ring capacity (events per process)
+    trace_capacity: int = 1 << 16
     # "" = Handel; "nsquare" / "gossipsub" select the comparison baselines
     # (simul/p2p; here handel_tpu/baselines/gossip.py)
     baseline: str = ""
@@ -173,6 +179,8 @@ def load_config(path: str) -> SimConfig:
         shared_verifier=bool(raw.get("shared_verifier", False)),
         mesh_devices=int(raw.get("mesh_devices", 1)),
         debug=bool(raw.get("debug", False)),
+        trace=bool(raw.get("trace", False)),
+        trace_capacity=int(raw.get("trace_capacity", 1 << 16)),
         baseline=str(raw.get("baseline", "")),
         master_ip=str(raw.get("master_ip", "127.0.0.1")),
         base_port=int(raw.get("base_port", 0)),
@@ -241,6 +249,8 @@ def dump_config(cfg: SimConfig) -> str:
         f"shared_verifier = {str(cfg.shared_verifier).lower()}",
         f"mesh_devices = {cfg.mesh_devices}",
         f"debug = {str(cfg.debug).lower()}",
+        f"trace = {str(cfg.trace).lower()}",
+        f"trace_capacity = {cfg.trace_capacity}",
         f'baseline = "{cfg.baseline}"',
         f'master_ip = "{cfg.master_ip}"',
         f"base_port = {cfg.base_port}",

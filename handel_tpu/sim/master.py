@@ -28,8 +28,11 @@ async def run_master(
     timeout: float,
     data_filter: DataFilter | None = None,
     extra: dict[str, float] | None = None,
+    expected_keys: list[str] | None = None,
 ) -> int:
-    monitor = Monitor(monitor_port, data_filter=data_filter)
+    monitor = Monitor(
+        monitor_port, data_filter=data_filter, expected_keys=expected_keys or ()
+    )
     # run/nodes/threshold/failing columns the plots key on (platform.py does
     # this in-process; the standalone master takes them from the CLI)
     monitor.stats.extra.update(extra or {})

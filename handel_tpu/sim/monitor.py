@@ -9,6 +9,13 @@ Measure kinds mirrored here: `TimeMeasure` (wall + user/system CPU via
 resource.getrusage, measure.go:54-143 + rtime.go:17-26), `CounterIO`
 (delta of a Values() map), and single values. The TPU addition: kernel-time
 counters flow through the same pipe (SURVEY.md §5.1).
+
+Observability additions (ISSUE 4): `HistogramIO` ships fixed-log-bucket
+histograms (core/trace.py LogHistogram) through the same UDP pipe as sparse
+{bucket: count} maps; the master merges them by summing counts and emits
+`_p50/_p90/_p99/_n` CSV columns next to the classic stats. Large payloads
+are chunked below the UDP-safe datagram size instead of risking an
+oversized-send OSError silently swallowing the whole measure.
 """
 
 from __future__ import annotations
@@ -18,7 +25,14 @@ import json
 import math
 import resource
 import time
-from typing import Mapping
+import warnings
+from typing import Iterator, Mapping, Sequence
+
+from handel_tpu.core.trace import LogHistogram
+
+# conservative single-datagram budget: 1500 MTU minus IP/UDP headers with
+# margin — loopback allows much more, but the multi-host master does not
+MAX_DATAGRAM = 1400
 
 
 # -- node side: the sink client ---------------------------------------------
@@ -35,7 +49,23 @@ class Sink:
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
 
     def record(self, name: str, values: Mapping[str, float]) -> None:
-        payload = {"name": name, "values": {k: float(v) for k, v in values.items()}}
+        vals = {k: float(v) for k, v in values.items()}
+        for payload in _chunk_values(name, vals):
+            self._send(payload)
+
+    def record_histograms(
+        self, name: str, hists: Mapping[str, LogHistogram]
+    ) -> None:
+        """Ship each histogram as sparse bucket maps, chunked per datagram;
+        the master merges chunks by summing bucket counts (LogHistogram
+        .merge_sparse), so a split histogram reassembles exactly."""
+        for key, h in hists.items():
+            if h.count == 0:
+                continue  # nothing measured: the master emits NaN columns
+            for payload in _chunk_hist(name, key, h):
+                self._send(payload)
+
+    def _send(self, payload: dict) -> None:
         try:
             self._sock.sendto(json.dumps(payload).encode(), self.addr)
         except OSError:
@@ -43,6 +73,61 @@ class Sink:
 
     def close(self) -> None:
         self._sock.close()
+
+
+def _chunk_values(name: str, vals: dict[str, float]) -> Iterator[dict]:
+    """Split a values map into payloads whose JSON stays <= MAX_DATAGRAM.
+
+    One oversized sendto raises OSError and (fire-and-forget) loses EVERY
+    key of the measure; chunking loses none. Sizes are computed on the
+    JSON-encoded items themselves, so the estimate is exact up to the two
+    enclosing braces."""
+    base = len(json.dumps({"name": name, "values": {}}).encode())
+    out: dict[str, float] = {}
+    size = base
+    for k, v in vals.items():
+        item = len(json.dumps({k: v}).encode())  # includes braces ≈ separator slack
+        if out and size + item > MAX_DATAGRAM:
+            yield {"name": name, "values": out}
+            out, size = {}, base
+        out[k] = v
+        size += item
+    if out or not vals:
+        yield {"name": name, "values": out}
+
+
+def _chunk_hist(name: str, key: str, h: LogHistogram) -> Iterator[dict]:
+    """Split one histogram's sparse buckets across datagrams. Every chunk
+    repeats lo/hi (idempotent min/max merge); `sum` rides the first chunk
+    only, so the master-side total adds up exactly once."""
+    sparse = h.to_sparse()
+    items = list(sparse["b"].items())
+    base = len(
+        json.dumps(
+            {"name": name, "hists": {key: {"b": {}, "lo": sparse["lo"],
+                                           "hi": sparse["hi"], "sum": sparse["sum"]}}}
+        ).encode()
+    )
+    first = True
+    out: dict[str, int] = {}
+    size = base
+    for bk, bc in items:
+        item = len(json.dumps({bk: bc}).encode())
+        if out and size + item > MAX_DATAGRAM:
+            yield _hist_payload(name, key, out, sparse, include_sum=first)
+            first = False
+            out, size = {}, base
+        out[bk] = bc
+        size += item
+    if out:
+        yield _hist_payload(name, key, out, sparse, include_sum=first)
+
+
+def _hist_payload(name, key, buckets, sparse, include_sum):
+    body = {"b": buckets, "lo": sparse["lo"], "hi": sparse["hi"]}
+    if include_sum:
+        body["sum"] = sparse["sum"]
+    return {"name": name, "hists": {key: body}}
 
 
 class TimeMeasure:
@@ -101,6 +186,21 @@ class CounterIO:
         )
 
 
+class HistogramIO:
+    """Ships a reporter's `histograms()` map (key -> LogHistogram) through
+    the sink at record time. Histograms are cumulative over the run, so no
+    construction-time base is needed — record once at run end, like the
+    reference records its measures at the END barrier."""
+
+    def __init__(self, sink: Sink, name: str, reporter):
+        self.sink = sink
+        self.name = name
+        self.reporter = reporter
+
+    def record(self) -> None:
+        self.sink.record_histograms(self.name, self.reporter.histograms())
+
+
 # -- master side: the sink server + stats ------------------------------------
 
 
@@ -115,19 +215,30 @@ class _SinkProto(asyncio.DatagramProtocol):
         try:
             msg = json.loads(data.decode())
             name = str(msg["name"])
-            values = msg["values"]
-        except (ValueError, KeyError):
+            values = msg.get("values", {})
+            hists = msg.get("hists", {})
+        except (ValueError, KeyError, AttributeError):
             return
-        for k, v in values.items():
-            self.mon.stats.update(f"{name}_{k}", float(v))
+        try:
+            for k, v in values.items():
+                self.mon.stats.update(f"{name}_{k}", float(v))
+            for k, payload in hists.items():
+                self.mon.stats.update_hist(f"{name}_{k}", payload)
+        except (ValueError, TypeError, AttributeError):
+            return  # malformed measure: drop, never kill the endpoint
 
 
 class Monitor:
     """UDP sink aggregating every node's measures (monitor.go:41-156)."""
 
-    def __init__(self, port: int, data_filter: "DataFilter | None" = None):
+    def __init__(
+        self,
+        port: int,
+        data_filter: "DataFilter | None" = None,
+        expected_keys: Sequence[str] = (),
+    ):
         self.port = port
-        self.stats = Stats(data_filter=data_filter)
+        self.stats = Stats(data_filter=data_filter, expected=expected_keys)
         self._transport = None
 
     async def start(self) -> None:
@@ -160,35 +271,72 @@ class DataFilter:
         return [v for v in values if v <= cut]
 
 
+HIST_STATS = ("p50", "p90", "p99", "n")
+
+
 class Stats:
-    """Per-key streaming min/max/avg/sum/dev (stats.go:23-480)."""
+    """Per-key streaming min/max/avg/sum/dev (stats.go:23-480), plus merged
+    log-bucket histograms (`_p50/_p90/_p99/_n` columns) and a stable schema:
+    a declared key with zero samples still emits its columns — as NaN, with
+    a warning — so CSVs from degraded runs line up with healthy ones."""
 
     def __init__(
         self,
         extra: Mapping[str, float] | None = None,
         data_filter: DataFilter | None = None,
+        expected: Sequence[str] = (),
     ):
         self._keys: dict[str, list[float]] = {}
+        self._hists: dict[str, LogHistogram] = {}
+        self._expected: set[str] = set(expected)
         self.extra = dict(extra or {})
         self.filter = data_filter or DataFilter()
 
     def update(self, key: str, value: float) -> None:
         self._keys.setdefault(key, []).append(value)
 
+    def update_hist(self, key: str, payload: Mapping) -> None:
+        """Merge one sparse-histogram datagram (LogHistogram.merge_sparse)."""
+        self._hists.setdefault(key, LogHistogram()).merge_sparse(payload)
+
+    def declare(self, *keys: str) -> None:
+        """Pin keys into the schema: zero samples -> NaN columns + warning
+        instead of silently narrowing the CSV (plots keyed on the column
+        would otherwise drop the whole run)."""
+        self._expected.update(keys)
+
+    def _stat_keys(self) -> list[str]:
+        return sorted(set(self._keys) | self._expected)
+
     def columns(self) -> list[str]:
         cols = sorted(self.extra)
-        for key in sorted(self._keys):
+        for key in self._stat_keys():
             cols += [f"{key}_{s}" for s in ("min", "max", "avg", "sum", "dev")]
+        for key in sorted(self._hists):
+            cols += [f"{key}_{s}" for s in HIST_STATS]
         return cols
 
     def row(self) -> list[float]:
         out = [self.extra[k] for k in sorted(self.extra)]
-        for key in sorted(self._keys):
-            vs = self.filter.apply(key, self._keys[key])
+        for key in self._stat_keys():
+            vs = self.filter.apply(key, self._keys.get(key, []))
+            if not vs:
+                warnings.warn(
+                    f"stats key {key!r} has no samples this run; "
+                    f"emitting NaN columns to keep the CSV schema stable",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+                out += [float("nan")] * 5
+                continue
             n = len(vs)
             avg = sum(vs) / n
             dev = math.sqrt(sum((v - avg) ** 2 for v in vs) / n)
             out += [min(vs), max(vs), avg, sum(vs), dev]
+        for key in sorted(self._hists):
+            h = self._hists[key]
+            out += [h.quantile(0.5), h.quantile(0.9), h.quantile(0.99),
+                    float(h.count)]
         return out
 
     def write_csv(self, path: str, append: bool = False) -> None:

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import os
 import sys
 
 from handel_tpu.core.crypto import Constructor, verify_multisignature
@@ -32,9 +33,10 @@ from handel_tpu.sim.adversary import (
     build_adversary,
     check_threshold_reachable,
 )
+from handel_tpu.core.trace import FlightRecorder
 from handel_tpu.sim.allocator import new_allocator
 from handel_tpu.sim.config import load_config
-from handel_tpu.sim.monitor import CounterIO, Sink, TimeMeasure
+from handel_tpu.sim.monitor import CounterIO, HistogramIO, Sink, TimeMeasure
 from handel_tpu.sim.sync import STATE_END, STATE_START, SyncSlave
 
 MSG = b"handel-tpu simulation message"
@@ -64,6 +66,13 @@ async def run_node_process(args) -> int:
     )
     ids = [int(x) for x in args.ids.split(",") if x != ""]
     threshold = run.resolved_threshold()
+
+    # span flight recorder (core/trace.py): one ring per process, every
+    # logical node recording under its id as the Chrome-trace tid; dumped
+    # as trace_<first-id>.json into --trace-dir after the END barrier
+    recorder = None
+    if getattr(args, "trace_dir", ""):
+        recorder = FlightRecorder(capacity=cfg.trace_capacity, pid=os.getpid())
 
     sink = Sink(args.monitor) if args.monitor else None
     # process-wide batch-plane telemetry (SURVEY.md §5.1): G2 subgroup-check
@@ -136,7 +145,9 @@ async def run_node_process(args) -> int:
         def host_fallback(msg, reqs, _c=scheme.constructor, _pk=pubkeys):
             return Constructor.batch_verify(_c, msg, _pk, reqs)
 
-        shared_service = BatchVerifierService(device, fallback=host_fallback)
+        shared_service = BatchVerifierService(
+            device, fallback=host_fallback, recorder=recorder
+        )
         if plane is not None:
             plane.add("verifier", shared_service)
             plane.add("launch", launch_timer)
@@ -194,6 +205,7 @@ async def run_node_process(args) -> int:
         else:
             hconf = run.handel.to_config(threshold, seed=nid)
             hconf.batch_size = cfg.batch_size
+            hconf.recorder = recorder
             if shared_service is not None:
                 hconf.verifier = shared_service.verify
             elif rpc_client is not None:
@@ -236,9 +248,13 @@ async def run_node_process(args) -> int:
     for nid, h, net in handels:
         if sink:
             # Handel.values() now carries the whole per-node plane —
-            # processing + store + penalty counters; gossip reports itself
+            # processing + store + penalty counters; gossip reports itself.
+            # Histogram reporters additionally ship the latency
+            # distributions behind the _p50/_p90/_p99 CSV columns.
             ms = [TimeMeasure(sink, "sigen"), CounterIO(sink, "net", net),
                   CounterIO(sink, "sigs", h)]
+            if hasattr(h, "histograms"):
+                ms.append(HistogramIO(sink, "sigs", h))
             measures.append(tuple(ms))
         else:
             measures.append(None)
@@ -302,6 +318,10 @@ async def run_node_process(args) -> int:
     # this post-barrier record still lands.
     if device_meas is not None:
         device_meas.record()
+    if recorder is not None:
+        recorder.dump(
+            os.path.join(args.trace_dir, f"trace_{ids[0] if ids else 0}.json")
+        )
     for s in slaves:
         s.stop()
     if rpc_client is not None:
@@ -331,6 +351,9 @@ def main() -> int:
     # verifier on this port / verify through the fleet's device host
     ap.add_argument("--serve-verifier", type=int, default=0)
     ap.add_argument("--verifier", default="")
+    # span tracing: record a flight recorder (core/trace.py) and dump its
+    # Chrome trace_event JSON into this directory at run end
+    ap.add_argument("--trace-dir", default="")
     args = ap.parse_args()
     return asyncio.run(run_node_process(args))
 

@@ -188,11 +188,24 @@ class LocalhostPlatform:
                 by_proc.setdefault(slot.process, []).append(nid)
         active = sum(len(v) for v in by_proc.values())
 
-        # master services
-        monitor = Monitor(monitor_port)
+        # master services. Declared keys pin the CSV schema: a degraded run
+        # (every honest node timed out / adversarial-only reporters) emits
+        # NaN columns with a warning instead of silently narrowing the CSV
+        # the plots are keyed on (sim/monitor.py Stats.declare).
+        monitor = Monitor(
+            monitor_port,
+            expected_keys=("sigen_wall", "sigs_sigCheckedCt", "net_sentPackets"),
+        )
         await monitor.start()
         sync = SyncMaster(int(master_addr.rsplit(":", 1)[1]), active)
         await sync.start()
+
+        # span tracing: each node process dumps its flight recorder into the
+        # run's trace dir; `python -m handel_tpu.sim trace <dir>` analyzes it
+        trace_dir = ""
+        if cfg.trace:
+            trace_dir = os.path.join(self.dir, f"trace_{run_index}")
+            os.makedirs(trace_dir, exist_ok=True)
 
         procs = []
         try:
@@ -214,6 +227,8 @@ class LocalhostPlatform:
                     "--ids",
                     ",".join(map(str, ids)),
                 ]
+                if trace_dir:
+                    cmd += ["--trace-dir", trace_dir]
                 procs.append(
                     await asyncio.create_subprocess_exec(
                         *cmd,
@@ -252,15 +267,22 @@ class LocalhostPlatform:
             and all(rc == 0 for rc in rcs)
             and all(b"finished OK" in out for out, _ in outs)
         )
-        return RunResult(ok=ok, csv_path=csv_path, outputs=outs, returncodes=rcs)
+        return RunResult(
+            ok=ok,
+            csv_path=csv_path,
+            outputs=outs,
+            returncodes=rcs,
+            trace_dir=trace_dir,
+        )
 
 
 class RunResult:
-    def __init__(self, ok, csv_path, outputs, returncodes):
+    def __init__(self, ok, csv_path, outputs, returncodes, trace_dir=""):
         self.ok = ok
         self.csv_path = csv_path
         self.outputs = outputs
         self.returncodes = returncodes
+        self.trace_dir = trace_dir
 
 
 def new_platform(name: str, cfg: SimConfig, workdir: str):

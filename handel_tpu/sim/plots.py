@@ -28,8 +28,16 @@ def read_rows(path: str) -> list[dict[str, float]]:
 
 
 def _series(rows, xcol, ycol):
+    import math
+
+    # NaN cells come from declared-but-unsampled stats keys (sim/monitor.py
+    # schema stability): skip the point, keep the rest of the series
     pts = sorted(
-        (r[xcol], r[ycol]) for r in rows if xcol in r and ycol in r
+        (r[xcol], r[ycol])
+        for r in rows
+        if xcol in r
+        and ycol in r
+        and not (math.isnan(r[xcol]) or math.isnan(r[ycol]))
     )
     return [p[0] for p in pts], [p[1] for p in pts]
 
@@ -160,6 +168,34 @@ def plot_batch_plane(csvs: dict[str, str], out: str):
     if not series:
         raise ValueError("no batch-plane columns in the given CSVs")
     return _plot_xy(series, "nodes", "batch plane (ratio / ms)", out, logx=True)
+
+
+def plot_trace_timeline(wave: dict[int, tuple[float, float, float]], out: str):
+    """The aggregation wave from a traced run (sim/trace_cli.py
+    level_timeline): per level, the first -> last completion window across
+    nodes with the median marked — the per-run, per-level form of the
+    paper's logarithmic completion-time claim."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    if not wave:
+        raise ValueError("no level_complete events in the trace")
+    fig, ax = plt.subplots(figsize=(7, 4.5))
+    levels = sorted(wave)
+    for lvl in levels:
+        first, med, last = wave[lvl]
+        ax.plot([first, last], [lvl, lvl], lw=4, alpha=0.4, color="C0")
+        ax.plot([med], [lvl], marker="o", color="C0")
+    ax.set_xlabel("time since first event (s)")
+    ax.set_ylabel("level completed")
+    ax.set_yticks(levels)
+    ax.grid(True, alpha=0.3)
+    ax.set_title("aggregation wave: first-median-last completion per level")
+    fig.tight_layout()
+    fig.savefig(out, dpi=120)
+    return out
 
 
 KINDS = {

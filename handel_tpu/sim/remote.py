@@ -331,8 +331,12 @@ class RemotePlatform:
             len(ids) for procs in by_host_proc.values() for ids in procs.values()
         )
         # both bind 0.0.0.0 (sim/sync.py, sim/monitor.py) so off-host nodes
-        # can reach them at master_ip
-        monitor = Monitor(monitor_port)
+        # can reach them at master_ip. Declared keys keep the CSV schema
+        # stable when a degraded run records no samples (NaN + warning).
+        monitor = Monitor(
+            monitor_port,
+            expected_keys=("sigen_wall", "sigs_sigCheckedCt", "net_sentPackets"),
+        )
         await monitor.start()
         sync = SyncMaster(master_port, active)
         await sync.start()
@@ -352,6 +356,10 @@ class RemotePlatform:
                         f"--run {run_index} --ids {','.join(map(str, ids))} "
                         f"--tag {shlex.quote(conn.staging)}"
                     )
+                    if cfg.trace:
+                        # dumps land in the host's staging dir (node cwd);
+                        # ssh hosts keep them host-side for manual fetch
+                        flags += " --trace-dir ."
                     if serve_verifier:
                         if hidx == verifier_host_idx and not served:
                             flags += f" --serve-verifier {verifier_port}"

@@ -1,0 +1,217 @@
+"""Trace-analysis CLI: reconstruct the aggregation wave from trace dumps.
+
+`python -m handel_tpu.sim trace <run-trace-dir | trace.json ...>` loads the
+per-process Chrome `trace_event` dumps a traced run leaves behind
+(sim/node.py --trace-dir, or FlightRecorder.dump from an in-process
+cluster) and answers the questions the CSV cannot:
+
+- the aggregation wave: per level, when the first / median / last node
+  completed it (the paper's completion-time curve, observed per run);
+- slowest-span attribution: which pipeline stage (recv, queue, verify,
+  merge, dispatch_pack, device_verify, net_transit) the wall time went to;
+- per-contribution chains: recv -> queue -> verify -> merge span coverage,
+  surfacing where a contribution stalled.
+
+Options: `--merged out.json` writes the combined timeline (open in
+chrome://tracing or Perfetto); `--plot out.png` draws the wave via
+sim/plots.py; `--top N` bounds the attribution table.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+from handel_tpu.core.trace import merge_traces
+
+#: pipeline spans that make up a contribution's recv -> merge chain
+CHAIN_SPANS = ("recv", "queue", "verify", "merge")
+
+
+def load_traces(paths: list[str]) -> list[dict]:
+    """Load trace events from files and/or directories of trace_*.json."""
+    files: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(sorted(glob.glob(os.path.join(p, "trace_*.json"))))
+        else:
+            files.append(p)
+    if not files:
+        raise FileNotFoundError(f"no trace_*.json under {paths}")
+    exports = []
+    for f in files:
+        with open(f) as fh:
+            exports.append(json.load(fh))
+    return merge_traces(exports)["traceEvents"]
+
+
+def _t0(events: list[dict]) -> float:
+    tss = [e["ts"] for e in events if e.get("ph") in ("X", "i")]
+    return min(tss) if tss else 0.0
+
+
+def level_timeline(events: list[dict]) -> dict[int, tuple[float, float, float]]:
+    """Per protocol level: (first, median, last) completion time in seconds
+    relative to the earliest event — the aggregation wave."""
+    t0 = _t0(events)
+    by_level: dict[int, list[float]] = {}
+    for e in events:
+        if e.get("ph") == "i" and e.get("name") == "level_complete":
+            lvl = int(e.get("args", {}).get("level", -1))
+            by_level.setdefault(lvl, []).append((e["ts"] - t0) / 1e6)
+    out = {}
+    for lvl, tss in sorted(by_level.items()):
+        tss.sort()
+        out[lvl] = (tss[0], tss[len(tss) // 2], tss[-1])
+    return out
+
+
+def span_table(events: list[dict]) -> list[dict]:
+    """Aggregate complete ("X") spans by name: count/total/mean/max (ms),
+    sorted by total descending — the slowest-span attribution table."""
+    agg: dict[str, list[float]] = {}
+    for e in events:
+        if e.get("ph") == "X":
+            agg.setdefault(e["name"], []).append(e.get("dur", 0.0) / 1e3)
+    rows = []
+    for name, durs in agg.items():
+        rows.append(
+            {
+                "name": name,
+                "count": len(durs),
+                "total_ms": sum(durs),
+                "mean_ms": sum(durs) / len(durs),
+                "max_ms": max(durs),
+            }
+        )
+    rows.sort(key=lambda r: -r["total_ms"])
+    return rows
+
+
+def contribution_chains(events: list[dict]) -> dict[tuple, dict]:
+    """Group pipeline spans into per-contribution chains keyed by
+    (pid, tid, origin, level, rts, ind) — `rts` is the arrival stamp that
+    separates re-deliveries of the same aggregate, `ind` splits a packet's
+    multisig from its piggybacked individual sig (they share one recv).
+    Coverage is the UNION of the chain's span intervals over the
+    recv-start -> merge-end wall — the fraction of a contribution's life
+    the trace can attribute to a pipeline stage."""
+    recvs: dict[tuple, dict] = {}
+    chains: dict[tuple, list[dict]] = {}
+    for e in events:
+        if e.get("ph") != "X" or e.get("name") not in CHAIN_SPANS:
+            continue
+        a = e.get("args", {})
+        if "origin" not in a or "level" not in a or "rts" not in a:
+            continue
+        pkt_key = (e.get("pid", 0), e.get("tid", 0), a["origin"], a["level"],
+                   a["rts"])
+        if e["name"] == "recv":
+            recvs[pkt_key] = e
+        else:
+            chains.setdefault(pkt_key + (bool(a.get("ind")),), []).append(e)
+    out = {}
+    for key, evs in chains.items():
+        recv = recvs.get(key[:-1])
+        if recv is None:
+            continue
+        evs = evs + [recv]
+        names = {e["name"] for e in evs}
+        if "merge" not in names:
+            continue  # incomplete chain (e.g. never verified)
+        start = recv["ts"]
+        end = max(e["ts"] + e.get("dur", 0.0) for e in evs if e["name"] == "merge")
+        wall = end - start
+        ivs = sorted(
+            (max(e["ts"], start), min(e["ts"] + e.get("dur", 0.0), end))
+            for e in evs
+        )
+        covered, cur_lo, cur_hi = 0.0, None, None
+        for lo, hi in ivs:
+            if hi <= lo:
+                continue
+            if cur_hi is None or lo > cur_hi:
+                if cur_hi is not None:
+                    covered += cur_hi - cur_lo
+                cur_lo, cur_hi = lo, hi
+            else:
+                cur_hi = max(cur_hi, hi)
+        if cur_hi is not None:
+            covered += cur_hi - cur_lo
+        out[key] = {
+            "wall_ms": wall / 1e3,
+            "coverage": covered / wall if wall > 0 else 1.0,
+            "stages": {
+                n: sum(e.get("dur", 0.0) for e in evs if e["name"] == n) / 1e3
+                for n in sorted(names)
+            },
+        }
+    return out
+
+
+def main(argv: list[str]) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m handel_tpu.sim trace",
+        description="analyze a traced run's flight-recorder dumps",
+    )
+    ap.add_argument("paths", nargs="+", help="trace dir(s) or trace_*.json files")
+    ap.add_argument("--merged", default="", help="write combined Chrome trace JSON")
+    ap.add_argument("--plot", default="", help="write the aggregation-wave PNG")
+    ap.add_argument("--top", type=int, default=10, help="attribution rows shown")
+    args = ap.parse_args(argv)
+
+    events = load_traces(args.paths)
+    print(f"{len(events)} events loaded")
+
+    wave = level_timeline(events)
+    if wave:
+        print("\naggregation wave (level completion, s since first event):")
+        print(f"{'level':>6} {'first':>9} {'median':>9} {'last':>9} ")
+        for lvl, (first, med, last) in wave.items():
+            print(f"{lvl:>6} {first:>9.4f} {med:>9.4f} {last:>9.4f}")
+
+    rows = span_table(events)
+    if rows:
+        print("\nslowest-span attribution:")
+        print(f"{'span':>14} {'count':>8} {'total ms':>11} {'mean ms':>9} {'max ms':>9}")
+        for r in rows[: args.top]:
+            print(
+                f"{r['name']:>14} {r['count']:>8} {r['total_ms']:>11.2f} "
+                f"{r['mean_ms']:>9.3f} {r['max_ms']:>9.3f}"
+            )
+
+    chains = contribution_chains(events)
+    if chains:
+        worst = sorted(chains.items(), key=lambda kv: -kv[1]["wall_ms"])
+        cov = [c["coverage"] for c in chains.values()]
+        print(
+            f"\n{len(chains)} contribution chains; span coverage "
+            f"min={min(cov):.1%} median={sorted(cov)[len(cov) // 2]:.1%}"
+        )
+        print("slowest contributions (recv -> merge):")
+        for (pid, tid, origin, level, _rts, _ind), c in worst[: args.top]:
+            stages = " ".join(
+                f"{n}={ms:.2f}ms" for n, ms in c["stages"].items()
+            )
+            print(
+                f"  node {tid} origin={origin} level={level}: "
+                f"{c['wall_ms']:.2f} ms ({c['coverage']:.0%} attributed) {stages}"
+            )
+
+    if args.merged:
+        with open(args.merged, "w") as f:
+            json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+        print(f"\nmerged trace -> {args.merged}")
+    if args.plot:
+        from handel_tpu.sim.plots import plot_trace_timeline
+
+        plot_trace_timeline(wave, args.plot)
+        print(f"wave plot -> {args.plot}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

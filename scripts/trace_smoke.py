@@ -1,0 +1,52 @@
+"""Observability CI gate: an 8-node traced LocalCluster smoke run.
+
+Runs a fully traced in-process cluster (fake crypto, seconds on any
+machine), asserts the trace export is non-empty with every pipeline stage
+present and the contribution chains attributable, then prints the trace
+CLI's analysis — so a tracing regression fails CI on its own named step
+(.github/workflows/ci.yml) before the full tier runs.
+
+Usage: python scripts/trace_smoke.py
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from handel_tpu.core.test_harness import run_cluster  # noqa: E402
+from handel_tpu.core.trace import FlightRecorder  # noqa: E402
+from handel_tpu.sim import trace_cli  # noqa: E402
+
+
+def main() -> int:
+    rec = FlightRecorder(capacity=1 << 16)
+    finals = asyncio.run(run_cluster(8, recorder=rec))
+    assert len(finals) == 8, f"only {len(finals)}/8 nodes reached threshold"
+
+    events = rec.export()["traceEvents"]
+    assert events, "trace export is empty"
+    names = {e["name"] for e in events}
+    missing = {"recv", "queue", "verify", "merge", "level_complete"} - names
+    assert not missing, f"missing pipeline spans: {missing}"
+
+    with tempfile.TemporaryDirectory() as d:
+        rec.dump(os.path.join(d, "trace_0.json"))
+        loaded = trace_cli.load_traces([d])
+        chains = trace_cli.contribution_chains(loaded)
+        assert chains, "no contribution chains reconstructed"
+        best = max(c["coverage"] for c in chains.values())
+        assert best >= 0.95, f"best chain coverage {best:.1%} < 95%"
+        trace_cli.main([d, "--top", "5"])
+
+    print(f"\ntrace smoke OK: {len(events)} events, {len(chains)} chains, "
+          f"best coverage {best:.1%}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,226 @@
+"""Monitor-plane tests (ISSUE 4 satellites): chunked sink payloads,
+histogram round-trip + master-side merge into `_p50/_p90/_p99` columns,
+NaN columns for declared-but-unsampled keys, and the warn-once counter on
+the reporter plane."""
+
+import asyncio
+import csv
+import math
+
+import pytest
+
+from handel_tpu.core.trace import LogHistogram
+from handel_tpu.sim.monitor import (
+    MAX_DATAGRAM,
+    HistogramIO,
+    Monitor,
+    Sink,
+    Stats,
+    _chunk_hist,
+    _chunk_values,
+)
+from handel_tpu.sim.platform import free_ports
+
+
+# -- chunking (the oversized-datagram fix) -----------------------------------
+
+
+def test_chunk_values_respects_datagram_budget():
+    import json
+
+    vals = {f"aVeryLongCounterName_{i:04d}": float(i) * 1.234567 for i in range(300)}
+    chunks = list(_chunk_values("sigs", vals))
+    assert len(chunks) > 1  # this map cannot fit one datagram
+    seen = {}
+    for c in chunks:
+        wire = json.dumps(c).encode()
+        assert len(wire) <= MAX_DATAGRAM + 2, f"chunk of {len(wire)} bytes"
+        assert c["name"] == "sigs"
+        seen.update(c["values"])
+    assert seen == vals  # nothing lost, nothing duplicated
+
+
+def test_chunk_hist_reassembles_exactly():
+    import json
+
+    h = LogHistogram()
+    h.add(1e-5)
+    h.add(10.0)
+    # inflate every bucket to a 9-digit count so the sparse map overflows
+    # one datagram and the sum/lo/hi chunk protocol is exercised
+    for i in range(LogHistogram.NBUCKETS):
+        h.counts[i] += 123456789 + i
+        h.count += 123456789 + i
+    chunks = list(_chunk_hist("sigs", "latS", h))
+    assert len(chunks) >= 2
+    merged = LogHistogram()
+    for c in chunks:
+        wire = json.dumps(c).encode()
+        assert len(wire) <= MAX_DATAGRAM + 2
+        merged.merge_sparse(c["hists"]["latS"])
+    assert merged.count == h.count
+    assert merged.counts == h.counts
+    assert merged.sum == pytest.approx(h.sum)
+    assert merged.lo == pytest.approx(h.lo)
+    assert merged.hi == pytest.approx(h.hi)
+
+
+# -- end-to-end sink -> monitor -> stats CSV ---------------------------------
+
+
+class _HistReporter:
+    def __init__(self, values):
+        self.h = LogHistogram()
+        for v in values:
+            self.h.add(v)
+
+    def histograms(self):
+        return {"levelCompleteS": self.h}
+
+
+def test_monitor_roundtrip_chunked_and_hist(tmp_path):
+    """Sink -> Monitor -> Stats CSV: a >1-datagram values map arrives whole,
+    and two nodes' histograms merge into one distribution whose p50/p90/p99
+    columns land in the CSV (the acceptance-criteria schema)."""
+
+    async def go():
+        (port,) = free_ports(1)
+        mon = Monitor(port)
+        await mon.start()
+        sink = Sink(f"127.0.0.1:{port}")
+        big = {f"counter_{i:04d}": float(i) for i in range(300)}
+        sink.record("sigs", big)
+        # two "nodes" with disjoint latency populations
+        HistogramIO(sink, "sigs", _HistReporter([0.010] * 50)).record()
+        HistogramIO(sink, "sigs", _HistReporter([0.100] * 50)).record()
+        await asyncio.sleep(0.3)
+        mon.stop()
+        sink.close()
+        return mon.stats
+
+    stats = asyncio.run(go())
+    cols = stats.columns()
+    row = dict(zip(cols, stats.row()))
+    # every chunked key arrived
+    for i in range(300):
+        assert row[f"sigs_counter_{i:04d}_avg"] == float(i)
+    # histogram merge: 100 samples total, p50 near 10 ms, p99 near 100 ms
+    assert row["sigs_levelCompleteS_n"] == 100.0
+    assert row["sigs_levelCompleteS_p50"] == pytest.approx(0.010, rel=0.25)
+    assert row["sigs_levelCompleteS_p99"] == pytest.approx(0.100, rel=0.25)
+    assert row["sigs_levelCompleteS_p90"] >= row["sigs_levelCompleteS_p50"]
+    path = str(tmp_path / "stats.csv")
+    stats.write_csv(path)
+    with open(path) as f:
+        header = list(csv.reader(f))[0]
+    for s in ("p50", "p90", "p99"):
+        assert f"sigs_levelCompleteS_{s}" in header
+
+
+# -- stable schema: declared keys with zero samples --------------------------
+
+
+def test_declared_key_without_samples_emits_nan_columns(tmp_path):
+    stats = Stats(expected=("sigen_wall",))
+    stats.update("other", 1.0)
+    cols = stats.columns()
+    assert "sigen_wall_avg" in cols and "other_avg" in cols
+    with pytest.warns(RuntimeWarning, match="sigen_wall"):
+        row = dict(zip(cols, stats.row()))
+    assert math.isnan(row["sigen_wall_avg"])
+    assert row["other_avg"] == 1.0
+    # the CSV keeps the column (as "nan"), so downstream schemas stay stable
+    path = str(tmp_path / "s.csv")
+    with pytest.warns(RuntimeWarning):
+        stats.write_csv(path)
+    rows = list(csv.DictReader(open(path)))
+    assert math.isnan(float(rows[0]["sigen_wall_avg"]))
+
+
+def test_declared_key_with_samples_is_normal():
+    stats = Stats(expected=("sigen_wall",))
+    stats.update("sigen_wall", 2.0)
+    row = dict(zip(stats.columns(), stats.row()))
+    assert row["sigen_wall_avg"] == 2.0
+
+
+def test_plots_skip_nan_points(tmp_path):
+    from handel_tpu.sim.plots import _series
+
+    rows = [
+        {"nodes": 8.0, "y": 1.0},
+        {"nodes": 16.0, "y": float("nan")},
+        {"nodes": 32.0, "y": 3.0},
+    ]
+    xs, ys = _series(rows, "nodes", "y")
+    assert xs == [8.0, 32.0] and ys == [1.0, 3.0]
+
+
+# -- warn-once counters on the reporter plane --------------------------------
+
+
+class _CaptureLog:
+    def __init__(self):
+        self.warns = []
+        self.debugs = []
+
+    def warn(self, *a):
+        self.warns.append(a)
+
+    def debug(self, *a):
+        self.debugs.append(a)
+
+
+def test_warn_once_counter():
+    from handel_tpu.core.report import WarnOnce
+
+    log = _CaptureLog()
+    w = WarnOnce(log)
+    for _ in range(5):
+        w.warn("udp_decode", "boom")
+    w.warn("udp_icmp", "nope")
+    assert len(log.warns) == 2  # one WARN per distinct reason
+    assert len(log.debugs) == 4  # the suppressed repeats
+    assert w.total() == 6
+    assert w.values() == {"logWarnCt": 6.0}
+
+
+def test_handel_log_warn_ct_reaches_reporter_plane():
+    """Suppressed invalid-packet warnings stay visible as logWarnCt in the
+    per-node values() map the `sigs` CounterIO records."""
+    from handel_tpu.core.net import Packet
+    from handel_tpu.core.test_harness import LocalCluster
+
+    async def go():
+        cluster = LocalCluster(8)
+        h = cluster.handels[0]
+        for _ in range(3):
+            h.new_packet(Packet(origin=999, level=1, multisig=b"junk"))
+        vals = h.values()
+        assert vals["invalidPacketCt"] == 3.0
+        assert vals["logWarnCt"] == 3.0
+
+    asyncio.run(go())
+
+
+def test_udp_log_warn_ct(tmp_path):
+    """UDP decode errors count on the logWarnCt plane (warn-once logging)."""
+    from handel_tpu.network.udp import UDPNetwork
+
+    async def go():
+        (port,) = free_ports(1)
+        net = UDPNetwork(f"127.0.0.1:{port}")
+        await net.start()
+        import socket
+
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        for _ in range(4):
+            s.sendto(b"\x01", ("127.0.0.1", port))
+        await asyncio.sleep(0.2)
+        vals = net.values()
+        net.stop()
+        s.close()
+        assert vals["decodeErrors"] == 4.0
+        assert vals["logWarnCt"] == 4.0
+
+    asyncio.run(go())
