@@ -532,6 +532,15 @@ class Handel:
             "msgRcvCt": float(self.msg_rcv_ct),
             "invalidPacketCt": float(self.invalid_packet_ct),
             "bannedPacketCt": float(self.banned_packet_ct),
+            # live aggregation-wave progress (the `sim watch` dashboard
+            # renders the fleet's distribution of this): levels fully
+            # received out of the level count, plus the best cardinality
+            "levelsCompletedCt": float(
+                sum(1 for l in self.levels.values() if l.rcv_completed)
+            ),
+            "bestCardinality": float(
+                self.best.cardinality() if self.best is not None else 0
+            ),
             **self._warn.values(),
             **self.proc.values(),
             **self.store.values(),
@@ -545,6 +554,14 @@ class Handel:
                 sum(lvl.demote_skips for lvl in self.levels.values())
             )
         return out
+
+    def gauge_keys(self) -> set[str]:
+        """Explicit gauge declarations for the metrics/monitor planes
+        (core/metrics.py is_gauge_key; the suffix heuristic is fallback)."""
+        keys = {"bestCardinality"} | self.proc.gauge_keys()
+        if self.scorer is not None:
+            keys |= self.scorer.gauge_keys()
+        return keys
 
     def histograms(self) -> dict[str, LogHistogram]:
         """Distribution measures for the monitor's histogram plane

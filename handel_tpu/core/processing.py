@@ -474,6 +474,12 @@ class BatchProcessing:
             **self.dedup.values(),
         }
 
+    def gauge_keys(self) -> set[str]:
+        """Explicit gauge declarations: the per-candidate averages and the
+        dedup cache's point-in-time keys must never be delta'd or averaged
+        as counters (sim/monitor.py CounterIO, core/metrics.py)."""
+        return {"sigQueueSize", "sigCheckingTime"} | self.dedup.gauge_keys()
+
     def histograms(self) -> dict[str, LogHistogram]:
         """Latency distributions for the monitor's histogram plane."""
         return {

@@ -43,6 +43,16 @@ class ReportAggregator:
                 out[f"{prefix}_{k}"] = float(v)
         return out
 
+    def gauge_keys(self) -> set[str]:
+        """Union of the children's explicit gauge declarations, carrying
+        the same prefix their values get (core/metrics.py is_gauge_key)."""
+        out: set[str] = set()
+        for prefix, rep in self._reporters.items():
+            gk = getattr(rep, "gauge_keys", None)
+            if callable(gk):
+                out |= {f"{prefix}_{k}" for k in gk()}
+        return out
+
 
 class WarnOnce:
     """Warn-once log gate with a reporter-plane counter.

@@ -93,6 +93,11 @@ class VerifiedAggCache:
             "dedupSize": float(len(self._map)),
         }
 
+    def gauge_keys(self) -> set[str]:
+        """Point-in-time keys, declared explicitly so the metrics/monitor
+        planes never delta them (core/metrics.py is_gauge_key)."""
+        return {"dedupHitRate", "dedupSize"}
+
 
 class SignatureStore:
     """Store of the best verified multisignature per level.

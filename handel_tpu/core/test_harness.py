@@ -47,6 +47,11 @@ class InProcessRouter:
                 # deliver asynchronously, like a real datagram (test.go:242-250)
                 loop.call_soon(lst.new_packet, Packet.decode(wire))
 
+    def values(self) -> dict[str, float]:
+        """Reporter surface: the cluster-wide transport plane (the udp/tcp
+        per-node counters' in-process analog, for the metrics registry)."""
+        return {"sentPackets": float(self.sent_packets)}
+
 
 class InProcessNetwork:
     """Per-node Network bound to a shared router (test.go:226-251)."""
@@ -92,6 +97,8 @@ class LocalCluster:
         chaos: ChaosConfig | None = None,
         adversaries: dict[int, str] | None = None,
         recorder=None,
+        metrics_port: int | None = None,
+        verifier_service=None,
     ):
         self.n = n
         self.scheme = scheme or FakeScheme()
@@ -155,17 +162,53 @@ class LocalCluster:
             )
         self.threshold = next(iter(self.handels.values())).threshold
 
+        # live telemetry (core/metrics.py): one registry + HTTP endpoint for
+        # the whole in-process cluster, every node's planes under a `node`
+        # label — the single-process analog of the sim platform's
+        # per-process /metrics servers. metrics_port=None = fully off.
+        self.metrics = None
+        self.metrics_server = None
+        self.verifier_service = verifier_service
+        if metrics_port is not None:
+            from handel_tpu.core.metrics import MetricsRegistry, MetricsServer
+
+            reg = MetricsRegistry()
+            for i, h in self.handels.items():
+                lbl = {"node": str(i)}
+                reg.register_values("sigs", h, labels=lbl)
+                reg.register_histograms("sigs", h, labels=lbl)
+                if h.scorer is not None:
+                    reg.register_values("penalty", h.scorer, labels=lbl)
+            reg.register_values("net", self.router)
+            if verifier_service is not None:
+                reg.register_values("device_verifier", verifier_service)
+            self._started = False
+            reg.add_readiness("cluster_started", lambda: self._started)
+            reg.add_readiness(
+                "breaker_closed",
+                lambda: (
+                    self.verifier_service is None
+                    or self.verifier_service.breaker.state != "open"
+                ),
+            )
+            self.metrics = reg
+            self.metrics_server = MetricsServer(reg, port=metrics_port).start()
+
     def start(self) -> None:
         for h in self.handels.values():
             h.start()
         for a in self.adversaries.values():
             a.start()
+        if self.metrics is not None:
+            self._started = True
 
     def stop(self) -> None:
         for h in self.handels.values():
             h.stop()
         for a in self.adversaries.values():
             a.stop()
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
 
     async def wait_complete_success(self, timeout: float = 10.0) -> dict[int, MultiSignature]:
         """Wait until every online node emitted a final signature >= threshold

@@ -395,3 +395,7 @@ class BatchVerifierService:
             # process-wide dedup plane (monitor keys: verifier_dedup*)
             **self.cache.values(),
         }
+
+    def gauge_keys(self) -> set[str]:
+        """Explicit gauge declarations (core/metrics.py is_gauge_key)."""
+        return {"verifierOccupancy", "breakerState"} | self.cache.gauge_keys()

@@ -5,6 +5,7 @@ in order on the chosen platform, abort a run after MaxTimeout.
 
 Usage: python -m handel_tpu.sim --config sim.toml --workdir out/
        python -m handel_tpu.sim trace <trace-dir>   (analyze a traced run)
+       python -m handel_tpu.sim watch sim.toml      (live /metrics dashboard)
 """
 
 from __future__ import annotations
@@ -24,6 +25,12 @@ def main() -> int:
         from handel_tpu.sim.trace_cli import main as trace_main
 
         return trace_main(sys.argv[2:])
+    if len(sys.argv) > 1 and sys.argv[1] == "watch":
+        # live-telemetry subcommand (sim/watch_cli.py): launch a run with
+        # metrics forced on and render the fleet's /metrics at ~1 Hz
+        from handel_tpu.sim.watch_cli import main as watch_main
+
+        return watch_main(sys.argv[2:])
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", required=True)
     ap.add_argument("--workdir", default="sim_out")

@@ -146,6 +146,15 @@ class SimConfig:
     # on chip-less hosts virtual CPU devices are forced to this count)
     mesh_devices: int = 1
     debug: bool = False
+    # live telemetry plane (core/metrics.py): every node process serves
+    # /metrics + /healthz + /readyz on its own port (allocated by the
+    # platform, written to <workdir>/metrics_ports.json); `metrics = false`
+    # keeps the plane fully off — zero threads, zero sockets
+    metrics: bool = False
+    # seconds a node keeps its metrics endpoint up after the END barrier so
+    # scrapers (`sim watch`, Prometheus) catch the final counter state of a
+    # short run; 0 = exit immediately
+    metrics_linger_s: float = 0.0
     # span tracing (core/trace.py): node processes record a per-contribution
     # flight recorder and dump Chrome trace_event JSON into the run's
     # trace dir; analyze with `python -m handel_tpu.sim trace <dir>`
@@ -179,6 +188,8 @@ def load_config(path: str) -> SimConfig:
         shared_verifier=bool(raw.get("shared_verifier", False)),
         mesh_devices=int(raw.get("mesh_devices", 1)),
         debug=bool(raw.get("debug", False)),
+        metrics=bool(raw.get("metrics", False)),
+        metrics_linger_s=float(raw.get("metrics_linger_s", 0.0)),
         trace=bool(raw.get("trace", False)),
         trace_capacity=int(raw.get("trace_capacity", 1 << 16)),
         baseline=str(raw.get("baseline", "")),
@@ -249,6 +260,8 @@ def dump_config(cfg: SimConfig) -> str:
         f"shared_verifier = {str(cfg.shared_verifier).lower()}",
         f"mesh_devices = {cfg.mesh_devices}",
         f"debug = {str(cfg.debug).lower()}",
+        f"metrics = {str(cfg.metrics).lower()}",
+        f"metrics_linger_s = {cfg.metrics_linger_s}",
         f"trace = {str(cfg.trace).lower()}",
         f"trace_capacity = {cfg.trace_capacity}",
         f'baseline = "{cfg.baseline}"',

@@ -156,31 +156,38 @@ class CounterIO:
     """Delta-of-Values() measure (measure.go CounterMeasure): snapshot a
     reporter's counters at construction, record the difference.
 
-    Keys ending in a GAUGE_SUFFIX are point-in-time ratios or levels (hit
-    rates, launch occupancy, cache sizes, breaker state — e.g. the dedup
-    plane's `dedupHitRate`/`dedupSize`, core/store.py VerifiedAggCache.values,
-    and the verifier breaker's `breakerState`, parallel/batch_verifier.py):
-    `now - base` is meaningless for a ratio whenever the construction-time
-    snapshot is nonzero, so those are recorded as-is."""
+    Gauge keys are point-in-time ratios or levels (hit rates, launch
+    occupancy, cache sizes, breaker state): `now - base` is meaningless for
+    a ratio whenever the construction-time snapshot is nonzero, so those
+    are recorded as-is. Reporters declare their gauge keys EXPLICITLY via a
+    `gauge_keys()` method (core/store.py VerifiedAggCache, core/handel.py,
+    parallel/batch_verifier.py, ...) or the caller passes `gauges=`; the
+    name-suffix heuristic is kept only as a fallback, so a new
+    registry-backed gauge without a magic suffix can't be silently averaged
+    as a counter (core/metrics.py is_gauge_key is the one classifier)."""
 
     GAUGE_SUFFIXES = ("Rate", "Occupancy", "Size", "State")
 
-    def __init__(self, sink: Sink, name: str, reporter):
+    def __init__(self, sink: Sink, name: str, reporter, gauges=None):
         self.sink = sink
         self.name = name
         self.reporter = reporter
+        if gauges is not None:
+            self._gauges = set(gauges)
+        else:
+            gk = getattr(reporter, "gauge_keys", None)
+            self._gauges = set(gk()) if callable(gk) else set()
         self._base = dict(reporter.values())
+
+    def _is_gauge(self, key: str) -> bool:
+        return key in self._gauges or key.endswith(self.GAUGE_SUFFIXES)
 
     def record(self) -> None:
         now = self.reporter.values()
         self.sink.record(
             self.name,
             {
-                k: (
-                    v
-                    if k.endswith(self.GAUGE_SUFFIXES)
-                    else v - self._base.get(k, 0.0)
-                )
+                k: (v if self._is_gauge(k) else v - self._base.get(k, 0.0))
                 for k, v in now.items()
             },
         )
@@ -289,6 +296,7 @@ class Stats:
         self._keys: dict[str, list[float]] = {}
         self._hists: dict[str, LogHistogram] = {}
         self._expected: set[str] = set(expected)
+        self._gauges: set[str] = set()
         self.extra = dict(extra or {})
         self.filter = data_filter or DataFilter()
 
@@ -299,11 +307,24 @@ class Stats:
         """Merge one sparse-histogram datagram (LogHistogram.merge_sparse)."""
         self._hists.setdefault(key, LogHistogram()).merge_sparse(payload)
 
-    def declare(self, *keys: str) -> None:
+    def declare(self, *keys: str, gauge: bool = False) -> None:
         """Pin keys into the schema: zero samples -> NaN columns + warning
         instead of silently narrowing the CSV (plots keyed on the column
-        would otherwise drop the whole run)."""
+        would otherwise drop the whole run). `gauge=True` additionally
+        declares them point-in-time, so downstream consumers (the metrics
+        registry bridging a Stats object, tests asserting classification)
+        never fall back to the name-suffix heuristic."""
         self._expected.update(keys)
+        if gauge:
+            self._gauges.update(keys)
+
+    def is_gauge(self, key: str) -> bool:
+        """Explicit declaration first, suffix heuristic as fallback
+        (the single classification rule, core/metrics.py is_gauge_key)."""
+        return key in self._gauges or key.endswith(CounterIO.GAUGE_SUFFIXES)
+
+    def gauge_keys(self) -> set[str]:
+        return set(self._gauges)
 
     def _stat_keys(self) -> list[str]:
         return sorted(set(self._keys) | self._expected)

@@ -45,6 +45,47 @@ MSG = b"handel-tpu simulation message"
 async def run_node_process(args) -> int:
     cfg = load_config(args.config)
     run = cfg.runs[args.run]
+
+    # live telemetry plane (core/metrics.py): the HTTP endpoint comes up
+    # BEFORE the scheme builds, so /healthz answers during a long warmup
+    # while /readyz stays 503 until the readiness probes pass — scheme
+    # warmed, breaker not open, monitor sink connected. `metrics = false`
+    # (or no --metrics-port from the platform) keeps the plane fully off:
+    # zero threads, zero sockets.
+    mreg = mserver = None
+    ready_state = {"scheme_warmed": False, "service": None}
+    if cfg.metrics and getattr(args, "metrics_port", -1) >= 0:
+        from handel_tpu.core.metrics import MetricsRegistry, MetricsServer
+
+        mreg = MetricsRegistry()
+        mreg.add_readiness(
+            "scheme_warmed", lambda: ready_state["scheme_warmed"]
+        )
+        mreg.add_readiness(
+            "breaker_closed",
+            lambda: (
+                ready_state["service"] is None
+                or ready_state["service"].breaker.state != "open"
+            ),
+        )
+        mreg.add_readiness(
+            "monitor_sink", lambda: bool(sink) or not args.monitor
+        )
+        sink = None  # readiness closes over it before the real bind below
+        mserver = MetricsServer(mreg, port=args.metrics_port).start()
+        # the BOUND port is authoritative (--metrics-port 0 = ephemeral):
+        # drop it next to the config so scrapers can discover manual runs
+        addr_path = os.path.join(
+            os.path.dirname(os.path.abspath(args.config)),
+            f"metrics_{args.ids.split(',')[0]}.addr",
+        )
+        try:
+            with open(addr_path, "w") as f:
+                f.write(mserver.address + "\n")
+        except OSError:
+            pass
+        print(f"metrics: serving on http://{mserver.address}", flush=True)
+
     if is_device_scheme(cfg.scheme):
         # select the JAX backend BEFORE the scheme module imports jax;
         # fake/host schemes never touch jax at all. mesh_devices > 1 on a
@@ -66,6 +107,9 @@ async def run_node_process(args) -> int:
     )
     ids = [int(x) for x in args.ids.split(",") if x != ""]
     threshold = run.resolved_threshold()
+    # scheme construction runs the device warmup (models/bn254_jax.py
+    # warms its kernels at build); fake/host schemes are warm by definition
+    ready_state["scheme_warmed"] = True
 
     # span flight recorder (core/trace.py): one ring per process, every
     # logical node recording under its id as the Chrome-trace tid; dumped
@@ -148,6 +192,7 @@ async def run_node_process(args) -> int:
         shared_service = BatchVerifierService(
             device, fallback=host_fallback, recorder=recorder
         )
+        ready_state["service"] = shared_service
         if plane is not None:
             plane.add("verifier", shared_service)
             plane.add("launch", launch_timer)
@@ -233,6 +278,39 @@ async def run_node_process(args) -> int:
                     hconf,
                 )
         handels.append((nid, h, net))
+
+    # registry-backed scrape surfaces: every logical node's protocol (sigs),
+    # transport (net) and peer-penalty planes under a node label, the
+    # process-wide verifier under device_verifier, device/XLA state under
+    # device, host crypto counters under host (naming: handel_<plane>_<key>)
+    if mreg is not None:
+        for nid, h, net in handels:
+            lbl = {"node": str(nid)}
+            if hasattr(h, "values"):
+                mreg.register_values("sigs", h, labels=lbl)
+            if hasattr(h, "histograms"):
+                mreg.register_histograms("sigs", h, labels=lbl)
+            if hasattr(net, "values"):
+                mreg.register_values("net", net, labels=lbl)
+            scorer = getattr(h, "scorer", None)
+            if scorer is not None:
+                mreg.register_values("penalty", scorer, labels=lbl)
+        if shared_service is not None:
+            mreg.register_values("device_verifier", shared_service)
+        if plane is not None:
+            mreg.register_values("host", plane)
+        if recorder is not None:
+            mreg.register_values("trace", recorder)
+        if is_device_scheme(cfg.scheme) and not cfg.baseline:
+            from handel_tpu.parallel.telemetry import DeviceTelemetry
+
+            telemetry = DeviceTelemetry(
+                service=shared_service,
+                trace_dir=getattr(args, "trace_dir", "")
+                or os.path.dirname(os.path.abspath(args.config)),
+            )
+            mreg.register_values("device", telemetry)
+            mserver.set_profiler(telemetry.profile)
 
     # barrier: ready to start (one slave per logical node id)
     slaves = []
@@ -322,6 +400,12 @@ async def run_node_process(args) -> int:
         recorder.dump(
             os.path.join(args.trace_dir, f"trace_{ids[0] if ids else 0}.json")
         )
+    if mserver is not None:
+        # keep the endpoint up briefly so scrapers catch the final counter
+        # state of a short run (`sim watch` sets this; default 0)
+        if cfg.metrics_linger_s > 0:
+            await asyncio.sleep(cfg.metrics_linger_s)
+        mserver.stop()
     for s in slaves:
         s.stop()
     if rpc_client is not None:
@@ -354,6 +438,10 @@ def main() -> int:
     # span tracing: record a flight recorder (core/trace.py) and dump its
     # Chrome trace_event JSON into this directory at run end
     ap.add_argument("--trace-dir", default="")
+    # live telemetry (core/metrics.py): serve /metrics+/healthz+/readyz on
+    # this port (0 = ephemeral, bound port written next to the config);
+    # absent (-1) or `metrics = false` in the TOML = plane fully off
+    ap.add_argument("--metrics-port", type=int, default=-1)
     args = ap.parse_args()
     return asyncio.run(run_node_process(args))
 

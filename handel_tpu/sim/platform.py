@@ -120,6 +120,50 @@ def port_plan(cfg, nodes: int) -> tuple[list[int], int, int, int]:
     return [base + i for i in range(nodes)], base - 2, base - 1, base - 3
 
 
+def metrics_port_plan(cfg, nodes: int, nprocs: int) -> list[int]:
+    """Per-process metrics ports (ISSUE 5 port hygiene): one /metrics
+    endpoint per node process, so multi-process runs on one host never
+    collide. With base_port set the plan is fixed ABOVE the node block
+    (base_port + nodes + 1 + i — the master/monitor/verifier slots live
+    below base, the node block ends at base + nodes); otherwise ports are
+    probed like the node ports. Empty when `metrics = false` — the plane
+    then costs zero sockets and zero threads."""
+    if not cfg.metrics or nprocs <= 0:
+        return []
+    if cfg.base_port:
+        lo = cfg.base_port + nodes + 1
+        if lo + nprocs > 65536:
+            raise ValueError(
+                f"base_port {cfg.base_port} with {nodes} nodes leaves no "
+                f"room for {nprocs} metrics ports above the node block"
+            )
+        return [lo + i for i in range(nprocs)]
+    return free_ports(nprocs)
+
+
+def write_metrics_ports(
+    workdir: str, run_index: int, by_proc_ports: dict[int, int]
+) -> str:
+    """Persist the run's metrics endpoints (`sim watch` discovery file):
+    {"run": i, "addresses": {"<process>": "127.0.0.1:<port>"}}."""
+    import json
+
+    path = os.path.join(workdir, "metrics_ports.json")
+    with open(path, "w") as f:
+        json.dump(
+            {
+                "run": run_index,
+                "addresses": {
+                    str(p): f"127.0.0.1:{port}"
+                    for p, port in sorted(by_proc_ports.items())
+                },
+            },
+            f,
+            indent=1,
+        )
+    return path
+
+
 def preflight_ports(ports: list[int]) -> None:
     """Fail fast if any fixed-plan port is already taken on this host:
     a silent bind failure inside one node process otherwise surfaces only
@@ -207,6 +251,19 @@ class LocalhostPlatform:
             trace_dir = os.path.join(self.dir, f"trace_{run_index}")
             os.makedirs(trace_dir, exist_ok=True)
 
+        # live telemetry: one /metrics endpoint per node process, plan
+        # written to the run dir BEFORE spawning so `sim watch` can attach
+        # from the first scrape (ISSUE 5)
+        metrics_ports = metrics_port_plan(cfg, run.nodes, len(by_proc))
+        metrics_by_proc: dict[int, int] = {}
+        if metrics_ports:
+            if cfg.base_port:
+                preflight_ports(metrics_ports)
+            metrics_by_proc = dict(
+                zip((p for p, _ in sorted(by_proc.items())), metrics_ports)
+            )
+            write_metrics_ports(self.dir, run_index, metrics_by_proc)
+
         procs = []
         try:
             for pidx, ids in sorted(by_proc.items()):
@@ -229,6 +286,8 @@ class LocalhostPlatform:
                 ]
                 if trace_dir:
                     cmd += ["--trace-dir", trace_dir]
+                if pidx in metrics_by_proc:
+                    cmd += ["--metrics-port", str(metrics_by_proc[pidx])]
                 procs.append(
                     await asyncio.create_subprocess_exec(
                         *cmd,

@@ -1,0 +1,347 @@
+"""`sim watch`: live terminal dashboard over a run's /metrics endpoints.
+
+Usage:
+    python -m handel_tpu.sim watch <config.toml> [--workdir DIR]
+        [--interval 1.0] [--snapshot PATH]
+    python -m handel_tpu.sim watch --attach <workdir>  (scrape a running run)
+
+The first form launches the simulation (forcing `metrics = true` and a
+short post-END linger so the final counter state is scrapeable), discovers
+every node process's endpoint from `<workdir>/metrics_ports.json`
+(sim/platform.py writes it before spawning), and refreshes an ANSI
+dashboard about once a second: the per-level completion wave across the
+fleet, verify/queue-wait p50/p99 from the merged histograms, dedup hit
+rate, breaker states, and penalty/ban counts. `--attach` skips launching
+and scrapes an existing run dir instead (e.g. one started by another
+terminal, or a remote run with forwarded ports).
+
+`--snapshot` writes the last successful raw /metrics scrape of every
+endpoint to a file — the captured evidence form (results/README.md).
+
+Everything here is stdlib: urllib scrapes, ANSI escape rendering (no
+curses dependency — a dumb pipe gets plain refreshing blocks instead).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import glob
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+from handel_tpu.core.metrics import merged_histogram, parse_exposition
+
+SCRAPE_TIMEOUT_S = 0.75
+
+
+# -- discovery ----------------------------------------------------------------
+
+
+def discover_endpoints(workdir: str) -> list[str]:
+    """Metrics addresses of a run dir: the platform's metrics_ports.json
+    plus any `metrics_*.addr` files dropped by manually started nodes."""
+    out: list[str] = []
+    path = os.path.join(workdir, "metrics_ports.json")
+    try:
+        with open(path) as f:
+            plan = json.load(f)
+        out.extend(plan.get("addresses", {}).values())
+    except (OSError, ValueError):
+        pass
+    for p in sorted(glob.glob(os.path.join(workdir, "metrics_*.addr"))):
+        try:
+            with open(p) as f:
+                addr = f.read().strip()
+            if addr and addr not in out:
+                out.append(addr)
+        except OSError:
+            continue
+    return out
+
+
+def scrape(addr: str) -> tuple[dict, str] | None:
+    """(parsed families, raw text) of one endpoint, or None when down."""
+    try:
+        with urllib.request.urlopen(
+            f"http://{addr}/metrics", timeout=SCRAPE_TIMEOUT_S
+        ) as r:
+            text = r.read().decode()
+        return parse_exposition(text), text
+    except (urllib.error.URLError, OSError, ValueError):
+        return None
+
+
+# -- aggregation --------------------------------------------------------------
+
+
+def _samples(fams: dict, name: str) -> list[tuple[dict, float]]:
+    fam = fams.get(name)
+    return list(fam["samples"]) if fam else []
+
+
+def _merge_all(scrapes: list[dict]) -> dict:
+    """Concatenate parsed families across endpoints (samples keep their
+    per-node labels, so nothing collapses)."""
+    merged: dict = {}
+    for fams in scrapes:
+        for name, fam in fams.items():
+            dst = merged.setdefault(name, {"type": fam["type"], "samples": []})
+            dst["samples"].extend(fam["samples"])
+    return merged
+
+
+def aggregate(scrapes: list[dict]) -> dict:
+    """The dashboard model from any number of parsed endpoint scrapes."""
+    fams = _merge_all(scrapes)
+
+    per_node_levels: dict[str, float] = {}
+    for labels, v in _samples(fams, "handel_sigs_levels_completed_ct"):
+        per_node_levels[labels.get("node", "?")] = v
+    best = [v for _, v in _samples(fams, "handel_sigs_best_cardinality")]
+
+    def hist_q(name, q):
+        h = merged_histogram(fams, name)
+        return h.quantile(q) if h else None
+
+    def total(name):
+        s = _samples(fams, name)
+        return sum(v for _, v in s) if s else None
+
+    def mean(name):
+        s = _samples(fams, name)
+        return sum(v for _, v in s) / len(s) if s else None
+
+    breaker = [v for _, v in _samples(
+        fams, "handel_device_verifier_breaker_state"
+    )] + [v for _, v in _samples(fams, "handel_device_breaker_state")]
+
+    return {
+        "nodes": len(per_node_levels),
+        "levels": per_node_levels,
+        "best_min": min(best) if best else None,
+        "best_max": max(best) if best else None,
+        "verify_p50": hist_q("handel_sigs_verify_latency_s", 0.5),
+        "verify_p99": hist_q("handel_sigs_verify_latency_s", 0.99),
+        "queue_p50": hist_q("handel_sigs_queue_wait_s", 0.5),
+        "queue_p99": hist_q("handel_sigs_queue_wait_s", 0.99),
+        "wave_p50": hist_q("handel_sigs_level_complete_s", 0.5),
+        "wave_p99": hist_q("handel_sigs_level_complete_s", 0.99),
+        "dedup_rate": mean("handel_device_verifier_dedup_hit_rate")
+        if fams.get("handel_device_verifier_dedup_hit_rate")
+        else mean("handel_sigs_dedup_hit_rate"),
+        "breaker_open": sum(1 for v in breaker if v >= 1.0),
+        "breaker_half": sum(1 for v in breaker if v == 0.5),
+        "breaker_total": len(breaker),
+        "penalty_reports": total("handel_penalty_peer_penalty_reports"),
+        "peers_banned": total("handel_penalty_peers_banned"),
+        "invalid_packets": total("handel_sigs_invalid_packet_ct"),
+        "net_sent": total("handel_net_sent_packets"),
+        "net_rcvd": total("handel_net_rcvd_packets"),
+        "net_dropped": total("handel_net_dropped_packets"),
+        "verifier_launches": total("handel_device_verifier_verifier_launches"),
+        "occupancy": mean("handel_device_verifier_verifier_occupancy"),
+        "families": len(fams),
+    }
+
+
+# -- rendering ----------------------------------------------------------------
+
+
+def _ms(v) -> str:
+    return "  --  " if v is None else f"{v * 1e3:6.1f}ms"
+
+
+def _num(v) -> str:
+    return "--" if v is None else f"{v:.0f}"
+
+
+def _bar(filled: int, total: int, width: int = 24) -> str:
+    if total <= 0:
+        return "." * width
+    n = round(width * filled / total)
+    return "#" * n + "." * (width - n)
+
+
+def render(model: dict, endpoints: list[str], up: int, tick: int) -> str:
+    """One dashboard frame as plain text (the caller adds ANSI)."""
+    lines = [
+        f"handel-tpu live telemetry — {up}/{len(endpoints)} endpoints up, "
+        f"{model['families']} families, scrape #{tick} "
+        f"@ {time.strftime('%H:%M:%S')}",
+        "",
+    ]
+    levels = model["levels"]
+    if levels:
+        max_l = int(max(levels.values()) or 0)
+        lines.append(f"aggregation wave ({model['nodes']} nodes reporting)")
+        for l in range(1, max_l + 1):
+            done = sum(1 for v in levels.values() if v >= l)
+            lines.append(
+                f"  level {l:>2} complete {_bar(done, len(levels))} "
+                f"{done}/{len(levels)}"
+            )
+        if model["best_min"] is not None:
+            lines.append(
+                f"  best cardinality  min {_num(model['best_min'])}  "
+                f"max {_num(model['best_max'])}"
+            )
+        if model["wave_p50"] is not None:
+            lines.append(
+                f"  level-complete    p50 {_ms(model['wave_p50'])}  "
+                f"p99 {_ms(model['wave_p99'])}"
+            )
+    else:
+        lines.append("aggregation wave: no sigs plane scraped yet")
+    lines.append("")
+    lines.append(
+        f"verify   p50 {_ms(model['verify_p50'])}  "
+        f"p99 {_ms(model['verify_p99'])}   "
+        f"queue wait p50 {_ms(model['queue_p50'])}  "
+        f"p99 {_ms(model['queue_p99'])}"
+    )
+    dd = model["dedup_rate"]
+    occ = model["occupancy"]
+    lines.append(
+        f"verifier launches {_num(model['verifier_launches'])}  "
+        f"occupancy {('--' if occ is None else f'{occ:.2f}')}  "
+        f"dedup hit rate {('--' if dd is None else f'{dd:.1%}')}"
+    )
+    if model["breaker_total"]:
+        state = (
+            f"{model['breaker_open']} open / {model['breaker_half']} "
+            f"half-open / {model['breaker_total']} total"
+        )
+    else:
+        state = "no verifier plane"
+    lines.append(f"breakers {state}")
+    lines.append(
+        f"penalties reports {_num(model['penalty_reports'])}  "
+        f"peers banned {_num(model['peers_banned'])}  "
+        f"invalid packets {_num(model['invalid_packets'])}"
+    )
+    lines.append(
+        f"network  sent {_num(model['net_sent'])}  "
+        f"rcvd {_num(model['net_rcvd'])}  "
+        f"dropped {_num(model['net_dropped'])}"
+    )
+    return "\n".join(lines)
+
+
+# -- the loop -----------------------------------------------------------------
+
+
+def watch_loop(
+    workdir: str,
+    interval: float,
+    done: threading.Event | None = None,
+    snapshot: str = "",
+    max_seconds: float = 0.0,
+    out=sys.stdout,
+) -> int:
+    """Scrape-and-render until `done` is set (and endpoints drain) or
+    `max_seconds` elapses. Returns the number of successful scrape rounds."""
+    tick = 0
+    rounds = 0
+    last_raw: dict[str, str] = {}
+    ansi = out.isatty() if hasattr(out, "isatty") else False
+    t0 = time.monotonic()
+    try:
+        while True:
+            endpoints = discover_endpoints(workdir)
+            results = [(a, scrape(a)) for a in endpoints]
+            parsed = [r[0] for _, r in results if r is not None]
+            for a, r in results:
+                if r is not None:
+                    last_raw[a] = r[1]
+            tick += 1
+            if parsed:
+                rounds += 1
+                frame = render(aggregate(parsed), endpoints, len(parsed), tick)
+                if ansi:
+                    out.write("\x1b[2J\x1b[H" + frame + "\n")
+                else:
+                    out.write(frame + "\n" + "-" * 72 + "\n")
+                out.flush()
+            finished = done is not None and done.is_set()
+            if finished and not parsed:
+                break  # run over and every endpoint drained
+            if max_seconds and time.monotonic() - t0 > max_seconds:
+                break
+            if done is None and tick > 3 and not parsed:
+                break  # attach mode: nothing answering any more
+            time.sleep(interval if not finished else min(interval, 0.2))
+    except KeyboardInterrupt:
+        pass
+    if snapshot and last_raw:
+        with open(snapshot, "w") as f:
+            for addr in sorted(last_raw):
+                f.write(f"# scrape http://{addr}/metrics\n")
+                f.write(last_raw[addr])
+                f.write("\n")
+        print(f"snapshot: {snapshot} ({len(last_raw)} endpoints)",
+              file=sys.stderr)
+    return rounds
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m handel_tpu.sim watch",
+        description="live dashboard over a simulation's /metrics endpoints",
+    )
+    ap.add_argument("config", nargs="?", help="simulation TOML to launch")
+    ap.add_argument("--attach", default="",
+                    help="scrape an existing run dir instead of launching")
+    ap.add_argument("--workdir", default="sim_out")
+    ap.add_argument("--interval", type=float, default=1.0)
+    ap.add_argument("--snapshot", default="",
+                    help="write the final raw /metrics scrape here")
+    ap.add_argument("--max-seconds", type=float, default=0.0,
+                    help="stop watching after this long (0 = until run end)")
+    args = ap.parse_args(argv)
+
+    if args.attach:
+        watch_loop(args.attach, args.interval, done=None,
+                   snapshot=args.snapshot, max_seconds=args.max_seconds)
+        return 0
+
+    if not args.config:
+        ap.error("need a config to launch, or --attach <workdir>")
+
+    from handel_tpu.sim.config import load_config
+    from handel_tpu.sim.platform import run_simulation
+
+    cfg = load_config(args.config)
+    cfg.metrics = True  # the whole point of watching
+    # keep endpoints up past END long enough for a final full scrape
+    cfg.metrics_linger_s = max(cfg.metrics_linger_s, 2.0 * args.interval)
+
+    done = threading.Event()
+    results: list = []
+
+    def run() -> None:
+        try:
+            results.extend(
+                asyncio.run(run_simulation(cfg, args.workdir))
+            )
+        finally:
+            done.set()
+
+    t = threading.Thread(target=run, name="sim-run", daemon=True)
+    t.start()
+    watch_loop(args.workdir, args.interval, done=done,
+               snapshot=args.snapshot, max_seconds=args.max_seconds)
+    t.join(timeout=cfg.max_timeout_s * (len(cfg.runs) + 1))
+    ok = bool(results) and all(r.ok for r in results)
+    for i, r in enumerate(results):
+        print(f"run {i}: {'success' if r.ok else 'FAILED'} -> {r.csv_path}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

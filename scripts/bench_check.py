@@ -1,0 +1,251 @@
+"""Bench-regression gate: compare the fresh bench artifact to its history.
+
+The driver persists one BENCH_r<NN>.json per round (repo root) and bench.py
+keeps the latest accelerator capture in results/bench_tpu.json — but until
+now nobody READ them, so a regression like PR 1's 22.5 -> 6.3 ms pack win
+could silently un-happen. This script loads the whole history, compares the
+fresh artifact like-for-like — same metric AND same backend, so a
+TPU-persisted p50 is never judged against a CPU-fallback smoke — and exits
+nonzero with a named report when any metric degrades more than
+`--threshold` (default 20%) against the trailing median.
+
+Usage:
+    python scripts/bench_check.py                 # gate (exit 1 on regression)
+    python scripts/bench_check.py --dry-run       # CI self-test: report only
+    python scripts/bench_check.py --history 'BENCH_*.json' \
+        --fresh results/bench_tpu.json --threshold 0.2 --min-history 2
+
+History records come in two shapes, both accepted: the driver wrapper
+({"n": .., "parsed": {<line>}}) and a raw bench line / persisted artifact.
+Persisted re-emits (source == "persisted") are deduped by captured_at so an
+outage round doesn't multiply one capture into fake history weight.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from statistics import median
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# metric key -> direction ("lower" is better, or "higher"). The headline
+# metric's name comes from the record itself (e.g. 4096sig_batch_verify_
+# p50_ms); the side metrics ride every accelerator line.
+SIDE_METRICS = {
+    "pipelined_p50_ms": "lower",
+    "host_pack_ms": "lower",
+    "dedup_hit_rate": "higher",
+}
+
+
+def normalize(obj: dict) -> dict | None:
+    """One bench record from either wrapper shape, or None when the round
+    produced no parsable line (rc != 0, empty tail)."""
+    if not isinstance(obj, dict):
+        return None
+    if "parsed" in obj or "rc" in obj:  # driver wrapper
+        rec = obj.get("parsed")
+        return rec if isinstance(rec, dict) else None
+    return obj if "metric" in obj else None
+
+
+def extract_metrics(rec: dict) -> dict[tuple[str, str], float]:
+    """{(metric name, backend): value} for every comparable number in one
+    record. Records without a backend tag (old CPU smokes) are keyed under
+    "cpu" only when their metric name says so, else skipped entirely —
+    an unlabeled number cannot be compared like-for-like."""
+    backend = rec.get("backend")
+    if not backend:
+        backend = "cpu" if "cpu_smoke" in str(rec.get("metric", "")) else None
+    if not backend:
+        return {}
+    out: dict[tuple[str, str], float] = {}
+    name, value = rec.get("metric"), rec.get("value")
+    if name and isinstance(value, (int, float)):
+        if not rec.get("forced_shape") and not rec.get("invalid_measurement"):
+            out[(str(name), backend)] = float(value)
+    for key in SIDE_METRICS:
+        v = rec.get(key)
+        if isinstance(v, (int, float)):
+            out[(key, backend)] = float(v)
+    return out
+
+
+def direction(metric: str) -> str:
+    return SIDE_METRICS.get(metric, "lower")
+
+
+def load_history(pattern: str) -> list[dict]:
+    """Chronologically ordered, deduped history records."""
+    recs: list[dict] = []
+    seen_capture: set[str] = set()
+    for path in sorted(glob.glob(pattern)):
+        try:
+            with open(path) as f:
+                rec = normalize(json.load(f))
+        except (OSError, ValueError):
+            continue
+        if rec is None:
+            continue
+        cap = rec.get("captured_at")
+        if rec.get("source") == "persisted" and cap:
+            if cap in seen_capture:
+                continue  # same capture re-emitted across outage rounds
+            seen_capture.add(cap)
+        elif cap:
+            seen_capture.add(cap)
+        recs.append(rec)
+    return recs
+
+
+def detect_regressions(
+    history: list[dict],
+    fresh: dict,
+    threshold: float = 0.20,
+    min_history: int = 2,
+) -> dict:
+    """Compare `fresh` against the trailing median of `history`,
+    like-for-like. Returns the full report:
+    {"regressions": [...], "improved": [...], "ok": [...], "skipped": [...]}.
+    Each entry names metric, backend, fresh value, trailing median, delta.
+    """
+    hist_vals: dict[tuple[str, str], list[float]] = {}
+    hist_backends: dict[str, set[str]] = {}
+    for rec in history:
+        for key, v in extract_metrics(rec).items():
+            hist_vals.setdefault(key, []).append(v)
+            hist_backends.setdefault(key[0], set()).add(key[1])
+
+    report = {"regressions": [], "improved": [], "ok": [], "skipped": []}
+    for (metric, backend), value in extract_metrics(fresh).items():
+        past = hist_vals.get((metric, backend), [])
+        if len(past) < min_history:
+            other = hist_backends.get(metric, set()) - {backend}
+            reason = (
+                f"history exists only for backend(s) {sorted(other)} — "
+                f"cross-backend comparison refused"
+                if other
+                else f"only {len(past)} comparable record(s) "
+                f"(< {min_history})"
+            )
+            report["skipped"].append(
+                {"metric": metric, "backend": backend, "value": value,
+                 "reason": reason}
+            )
+            continue
+        med = median(past)
+        if med == 0:
+            report["skipped"].append(
+                {"metric": metric, "backend": backend, "value": value,
+                 "reason": "trailing median is 0"}
+            )
+            continue
+        if direction(metric) == "lower":
+            delta = (value - med) / med
+        else:
+            delta = (med - value) / med
+        entry = {
+            "metric": metric,
+            "backend": backend,
+            "value": value,
+            "trailing_median": med,
+            "n_history": len(past),
+            "degradation": round(delta, 4),
+        }
+        if delta > threshold:
+            report["regressions"].append(entry)
+        elif delta < 0:
+            report["improved"].append(entry)
+        else:
+            report["ok"].append(entry)
+    return report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--history", default=os.path.join(REPO, "BENCH_*.json"),
+        help="glob of historical bench records (driver wrapper or raw line)",
+    )
+    ap.add_argument(
+        "--fresh", default=os.path.join(REPO, "results", "bench_tpu.json"),
+        help="the artifact under judgment",
+    )
+    ap.add_argument("--threshold", type=float, default=0.20,
+                    help="fractional degradation that fails the gate")
+    ap.add_argument("--min-history", type=int, default=2,
+                    help="comparable records required before judging")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="validate + report, always exit 0 (CI self-test)")
+    ap.add_argument("--json", default="", help="also write the report here")
+    args = ap.parse_args(argv)
+
+    history = load_history(args.history)
+    try:
+        with open(args.fresh) as f:
+            fresh = normalize(json.load(f))
+    except (OSError, ValueError) as e:
+        print(f"bench_check: cannot read fresh artifact {args.fresh}: {e}",
+              file=sys.stderr)
+        return 0 if args.dry_run else 2
+    if fresh is None:
+        print(f"bench_check: {args.fresh} holds no bench record",
+              file=sys.stderr)
+        return 0 if args.dry_run else 2
+
+    report = detect_regressions(
+        history, fresh, threshold=args.threshold,
+        min_history=args.min_history,
+    )
+    print(
+        f"bench_check: {len(history)} history records "
+        f"({os.path.basename(args.history)}), fresh = {args.fresh}"
+    )
+    for entry in report["regressions"]:
+        print(
+            f"  REGRESSION {entry['metric']} [{entry['backend']}]: "
+            f"{entry['value']:g} vs trailing median "
+            f"{entry['trailing_median']:g} over {entry['n_history']} runs "
+            f"({entry['degradation']:+.1%}, threshold "
+            f"{args.threshold:.0%})"
+        )
+    for entry in report["improved"]:
+        print(
+            f"  improved   {entry['metric']} [{entry['backend']}]: "
+            f"{entry['value']:g} vs median {entry['trailing_median']:g} "
+            f"({entry['degradation']:+.1%})"
+        )
+    for entry in report["ok"]:
+        print(
+            f"  ok         {entry['metric']} [{entry['backend']}]: "
+            f"{entry['value']:g} vs median {entry['trailing_median']:g} "
+            f"({entry['degradation']:+.1%})"
+        )
+    for entry in report["skipped"]:
+        print(
+            f"  skipped    {entry['metric']} [{entry['backend']}]: "
+            f"{entry['reason']}"
+        )
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=1)
+
+    if report["regressions"] and not args.dry_run:
+        print(
+            f"bench_check: FAILED — {len(report['regressions'])} metric(s) "
+            f"regressed past {args.threshold:.0%}",
+            file=sys.stderr,
+        )
+        return 1
+    if args.dry_run and report["regressions"]:
+        print("bench_check: dry-run — regressions reported, exit 0",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
