@@ -163,17 +163,22 @@ def host_pipeline_bench(
     trials: int = 20,
     seed: int = 77,
 ) -> dict:
-    """Host half of the verify pipeline, measured on ANY backend (no device
-    launches): per-launch packing cost of the vectorized packer vs the old
-    per-candidate loop at `lanes` candidates, and the dedup hit rate of the
-    service cache on a Handel-shaped duplicate-delivery trace (every
-    winning aggregate re-delivered by several peers). Returns the metric
-    dict merged into the bench line: host_pack_ms, host_pack_loop_ms,
-    host_pack_speedup, dedup_hit_rate.
+    """Host half of the verify pipeline, measured on ANY backend (no verify
+    kernel launches): per-launch cost of the zero-copy packer vs the old
+    per-candidate loop at `lanes` candidates — for BOTH the range path
+    (Handel's contiguous partitioner hulls) and the dense fallback
+    (scattered signer sets) — the staging-handoff half of dispatch
+    (`host_dispatch_ms`), a steady-state probe that pins the handoff to
+    explicit transfers only (`jax.transfer_guard`), and the dedup hit rate
+    of the service cache on a Handel-shaped duplicate-delivery trace.
+    Returns the metric dict merged into the bench line: host_pack_ms,
+    host_pack_loop_ms, host_pack_speedup, host_pack_dense_ms,
+    host_dispatch_ms, no_transfer_steady_state, dedup_hit_rate.
     """
     import asyncio
     import threading  # noqa: F401  (parity with the service's test stubs)
 
+    import jax
     import numpy as np
 
     from handel_tpu import native as nat
@@ -204,17 +209,55 @@ def host_pipeline_bench(
             if i not in holes:
                 bs.set(i, True)
         requests.append((bs, sig))
+    # dense-fallback phase: scattered signer sets (> MISS_CAP hull holes)
+    dense_requests = []
+    for _ in range(lanes):
+        bs = BitSet(n_registry)
+        for i in rng.sample(range(n_registry), n_registry // 4):
+            bs.set(i, True)
+        dense_requests.append((bs, sig))
 
-    def p50(pack):
+    def p50(pack, reqs):
         ts = []
         for _ in range(trials):
             t0 = time.perf_counter()
-            pack(requests)
+            pack(reqs)
             ts.append((time.perf_counter() - t0) * 1000.0)
         return float(np.percentile(ts, 50))
 
-    pack_vec_ms = p50(device._pack_requests)
-    pack_loop_ms = p50(device._pack_requests_loop)
+    # phase boundaries reset the device's cumulative host counters so no
+    # phase inherits the previous phase's accumulation
+    device.reset_host_counters()
+    pack_vec_ms = p50(device._pack_requests, requests)
+    pack_loop_ms = p50(device._pack_requests_loop, requests)
+    device.reset_host_counters()
+    pack_dense_ms = p50(device._pack_requests, dense_requests)
+    device.reset_host_counters()
+
+    def stage_p50(reqs):
+        ts = []
+        for _ in range(trials):
+            plan = device._pack_requests(reqs)
+            t0 = time.perf_counter()
+            device._stage_plan(plan)
+            ts.append((time.perf_counter() - t0) * 1000.0)
+        return float(np.percentile(ts, 50))
+
+    dispatch_ms = stage_p50(requests)
+
+    # steady-state no-transfer probe: with implicit host->device transfers
+    # disallowed, a warm pack+stage cycle must run clean (registry/prefix
+    # are device-resident; staging moves via explicit jax.device_put only)
+    try:
+        with jax.transfer_guard_host_to_device("disallow"):
+            device._stage_plan(device._pack_requests(requests))
+            device._stage_plan(device._pack_requests(dense_requests))
+        no_implicit = 1.0
+    except Exception as e:
+        print(f"bench: steady-state transfer probe tripped: {e}",
+              file=sys.stderr)
+        no_implicit = 0.0
+    device.reset_host_counters()
 
     # dedup hit rate over a multi-peer delivery trace: 32 distinct winning
     # aggregates, each re-delivered by 8 peers, shuffled — the shape
@@ -249,6 +292,9 @@ def host_pipeline_bench(
         "host_pack_speedup": round(pack_loop_ms / pack_vec_ms, 2)
         if pack_vec_ms > 0
         else None,
+        "host_pack_dense_ms": round(pack_dense_ms, 3),
+        "host_dispatch_ms": round(dispatch_ms, 3),
+        "no_transfer_steady_state": no_implicit,
         "dedup_hit_rate": round(vals["dedupHitRate"], 4),
     }
 

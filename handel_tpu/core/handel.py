@@ -28,7 +28,7 @@ from handel_tpu.core.penalty import (
     WEIGHT_PARSE_FAIL,
     PeerScorer,
 )
-from handel_tpu.core.processing import BatchProcessing
+from handel_tpu.core.processing import BatchProcessing, CombineShim
 from handel_tpu.core.report import WarnOnce
 from handel_tpu.core.store import SignatureStore
 from handel_tpu.core.timeout import LinearTimeout
@@ -203,7 +203,20 @@ class Handel:
         # monitor plane's _p50/_p90/_p99 columns (sim/monitor.py)
         self.hist_level_complete = LogHistogram()
 
-        self.store = SignatureStore(self.partitioner, self.c.new_bitset, constructor)
+        # batched aggregate combine: device constructors expose
+        # `device_combine`, and the shim routes the store's merge/patch
+        # point-addition chains through one combine_batch launch per group
+        # instead of one host pairing-library add per contribution; host
+        # constructors get no shim and the store keeps its serial path
+        self.combine_shim = CombineShim.for_constructor(constructor)
+        self.store = SignatureStore(
+            self.partitioner,
+            self.c.new_bitset,
+            constructor,
+            combiner=(
+                self.combine_shim.combine_many if self.combine_shim else None
+            ),
+        )
         # our own signature seeds the store at level 0 (handel.go:108-116)
         first_bs = self.c.new_bitset(1)
         first_bs.set(0, True)
@@ -544,6 +557,7 @@ class Handel:
             **self._warn.values(),
             **self.proc.values(),
             **self.store.values(),
+            **(self.combine_shim.values() if self.combine_shim else {}),
         }
         if self.scorer is not None:
             out.update(self.scorer.values())

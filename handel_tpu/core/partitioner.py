@@ -166,6 +166,7 @@ class BinomialPartitioner:
         sigs: Sequence[IncomingSig],
         level: int,
         new_bitset: Callable[[int], BitSet] = BitSet,
+        combiner: Callable[[list], object] | None = None,
     ) -> MultiSignature | None:
         """Merge per-level best sigs into one sig sized for sending to `level`.
 
@@ -186,12 +187,15 @@ class BinomialPartitioner:
             lo, _ = self.range_level(s.level)
             return lo - gmin
 
-        return self._combine_into(sigs, new_bitset(gmax - gmin), offset_of)
+        return self._combine_into(
+            sigs, new_bitset(gmax - gmin), offset_of, combiner
+        )
 
     def combine_full(
         self,
         sigs: Sequence[IncomingSig],
         new_bitset: Callable[[int], BitSet] = BitSet,
+        combiner: Callable[[list], object] | None = None,
     ) -> MultiSignature | None:
         """Merge per-level best sigs into a registry-sized multisignature."""
         if not sigs:
@@ -201,18 +205,27 @@ class BinomialPartitioner:
             lo, _ = self.range_level(s.level)
             return lo
 
-        return self._combine_into(sigs, new_bitset(self.size), offset_of)
+        return self._combine_into(sigs, new_bitset(self.size), offset_of, combiner)
 
-    def _combine_into(self, sigs, bitset: BitSet, offset_of) -> MultiSignature:
-        final_sig = None
+    def _combine_into(
+        self, sigs, bitset: BitSet, offset_of, combiner=None
+    ) -> MultiSignature:
+        parts = []
         for s in sigs:
             off = offset_of(s)
             bs = s.ms.bitset
             for i in bs.indices():
                 bitset.set(off + i, True)
-            final_sig = (
-                s.ms.signature
-                if final_sig is None
-                else final_sig.combine(s.ms.signature)
-            )
+            parts.append(s.ms.signature)
+        if not parts:
+            final_sig = None
+        elif len(parts) == 1 or combiner is None:
+            final_sig = parts[0]
+            for sig in parts[1:]:
+                final_sig = final_sig.combine(sig)
+        else:
+            # batched: one combiner call (device combine_batch launch)
+            # instead of one host point add per level (point addition is
+            # commutative; same group element as the serial fold)
+            final_sig = combiner(parts)
         return MultiSignature(bitset, final_sig)

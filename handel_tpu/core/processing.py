@@ -70,6 +70,122 @@ class IndividualSigFilter:
         return True
 
 
+class CombineShim:
+    """Accumulate-and-flush batcher for aggregate-signature point additions.
+
+    `SignatureStore` merge/patch chains and the partitioner's level
+    combination hand whole signature groups to `combine_many` (wired as the
+    store's `combiner` hook by core/handel.py); callers that can defer —
+    anything resolving several independent merges in one step — `accumulate`
+    groups and `flush`, and every group queued at flush time resolves in ONE
+    device `combine_batch` launch (models/bn254_jax.py) instead of one host
+    pairing-library point add per contribution.
+
+    Groups below `min_device_points` fold host-side: a device launch beats
+    the native host add only once enough point adds amortize its round
+    trip. The device hook is `constructor.device_combine(groups)`, which
+    returns None when the device is not ready, its breaker is open, or the
+    launch failed — every degradation lands on the host fold, never on an
+    error, so the shim is safe to wire unconditionally.
+    """
+
+    def __init__(self, device_combine, min_device_points: int = 4):
+        self.device_combine = device_combine
+        self.min_device_points = max(2, min_device_points)
+        self._queue: list[list] = []
+        self._flushed: list = []
+        # reporter counters (Handel.values merges them onto the sigs plane)
+        self.combine_groups = 0
+        self.combine_points = 0
+        self.combine_device_groups = 0
+        self.combine_host_groups = 0
+
+    @classmethod
+    def for_constructor(cls, constructor, **kw) -> "CombineShim | None":
+        """A shim when the constructor exposes a device combine hook
+        (BN254JaxConstructor.device_combine and subclasses), else None —
+        host schemes keep the store's plain serial path."""
+        fn = getattr(constructor, "device_combine", None)
+        return cls(fn, **kw) if callable(fn) else None
+
+    @staticmethod
+    def _host_fold(sigs):
+        sig = sigs[0]
+        for s in sigs[1:]:
+            sig = s.combine(sig)
+        return sig
+
+    def _resolve(self, groups: list[list]) -> list:
+        """Resolve many groups: one device launch for those wide enough to
+        pay for it, host folds for the rest (and for every group when the
+        device declines)."""
+        out: list = [None] * len(groups)
+        dev_idx = [
+            i
+            for i, g in enumerate(groups)
+            if len(g) >= self.min_device_points
+            and all(getattr(s, "point", None) is not None for s in g)
+        ]
+        if dev_idx and self.device_combine is not None:
+            pts = self.device_combine(
+                [[s.point for s in groups[i]] for i in dev_idx]
+            )
+            if pts is not None:
+                for i, p in zip(dev_idx, pts):
+                    if p is None:
+                        # declined (class not warmed) or a legitimate
+                        # infinity sum: both redo on the host, which is
+                        # correct either way and never compiles mid-round
+                        continue
+                    out[i] = type(groups[i][0])(p)
+                    self.combine_device_groups += 1
+        for i, g in enumerate(groups):
+            if out[i] is None:
+                out[i] = self._host_fold(g)
+                self.combine_host_groups += 1
+        return out
+
+    def combine_many(self, sigs):
+        """Synchronous combiner (the `SignatureStore.combiner` hook): one
+        group, resolved now — with any accumulated groups riding the same
+        launch."""
+        group = list(sigs)
+        self.combine_groups += 1
+        self.combine_points += len(group)
+        if self._queue:
+            queued, self._queue = self._queue, []
+            results = self._resolve(queued + [group])
+            self._flushed.extend(results[:-1])
+            return results[-1]
+        return self._resolve([group])[0]
+
+    def accumulate(self, sigs) -> int:
+        """Queue a group for the next flush; returns its result index."""
+        group = list(sigs)
+        self.combine_groups += 1
+        self.combine_points += len(group)
+        self._queue.append(group)
+        return len(self._queue) - 1
+
+    def flush(self) -> list:
+        """Resolve every accumulated group in one launch; returns their
+        combined signatures in accumulate order (plus any the last
+        `combine_many` already swept up, first)."""
+        swept, self._flushed = list(self._flushed), []
+        if not self._queue:
+            return swept
+        queued, self._queue = self._queue, []
+        return swept + self._resolve(queued)
+
+    def values(self) -> dict[str, float]:
+        return {
+            "combineGroups": float(self.combine_groups),
+            "combinePoints": float(self.combine_points),
+            "combineDeviceGroups": float(self.combine_device_groups),
+            "combineHostGroups": float(self.combine_host_groups),
+        }
+
+
 # An async verifier: (msg, registry pubkeys, [(global bitset, signature)]) ->
 # list of verdicts. The default wraps Constructor.batch_verify; the shared
 # device service in parallel/batch_verifier.py fuses many nodes' requests into

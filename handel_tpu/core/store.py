@@ -111,10 +111,16 @@ class SignatureStore:
         partitioner: BinomialPartitioner,
         new_bitset: Callable[[int], BitSet] = BitSet,
         constructor: Constructor | None = None,
+        combiner: Callable[[list], object] | None = None,
     ):
         self.part = partitioner
         self.nbs = new_bitset
         self.cons = constructor
+        # batched signature combiner: list of Signatures -> their combined
+        # Signature in ONE call (core/processing.py CombineShim routes it to
+        # the device scheme's combine_batch). None = host-serial
+        # `Signature.combine` folds, the reference behavior.
+        self.combiner = combiner
         # best multisignature per level (store.go:43)
         self.best_by_level: dict[int, MultiSignature] = {}
         self.highest = 0
@@ -205,28 +211,45 @@ class SignatureStore:
             return sp.ms, True
         self.replace_trial += 1
 
-        best = MultiSignature(sp.ms.bitset.clone(), sp.ms.signature)
+        # collect every signature the resulting best aggregates — the new
+        # candidate, the current best when disjoint, and the individual-sig
+        # patches — and combine them in ONE call at the end: a batched
+        # device scheme (combine_batch via `combiner`) then pays a single
+        # launch where the reference pays one pairing-library point add per
+        # contribution (store.go:201,225)
+        bits = sp.ms.bitset.clone()
+        parts = [sp.ms.signature]
         merged = sp.ms.bitset.or_(cur_best.bitset)
         if merged.cardinality() == cur_best.cardinality() + sp.ms.cardinality():
             # disjoint: aggregate the two signatures
-            best = MultiSignature(
-                merged, cur_best.signature.combine(sp.ms.signature)
-            )
+            bits = merged
+            parts.append(cur_best.signature)
 
         # patch holes with verified individual sigs (store.go:204-226)
         vl = self.indiv_verified[sp.level]
-        patchable = best.bitset.and_(vl).xor(vl)
-        if patchable.cardinality() + best.cardinality() <= cur_best.cardinality():
+        patchable = bits.and_(vl).xor(vl)
+        if patchable.cardinality() + bits.cardinality() <= cur_best.cardinality():
             return None, False
 
-        sig = best.signature
         for pos in patchable.indices():
-            ind = self.individual_sigs[sp.level][pos]
-            best.bitset.set(pos, True)
-            sig = ind.signature.combine(sig)
-        best = MultiSignature(best.bitset, sig)
+            parts.append(self.individual_sigs[sp.level][pos].signature)
+            bits.set(pos, True)
         self.success_replace += 1
-        return best, True
+        return MultiSignature(bits, self._combine_sigs(parts)), True
+
+    def _combine_sigs(self, parts: list):
+        """Sum a list of signatures: one batched-combiner call when wired
+        (point addition is commutative, so the batched sum is the same
+        group element as the reference's sequential fold), else the
+        reference's serial `Signature.combine` chain."""
+        if len(parts) == 1:
+            return parts[0]
+        if self.combiner is not None:
+            return self.combiner(parts)
+        sig = parts[0]
+        for s in parts[1:]:
+            sig = s.combine(sig)
+        return sig
 
     # -- queries (store.go:231-262) ----------------------------------------
 
@@ -243,7 +266,7 @@ class SignatureStore:
         ]
         if level < self.part.max_level():
             level += 1
-        return self.part.combine(sigs, level, self.nbs)
+        return self.part.combine(sigs, level, self.nbs, combiner=self.combiner)
 
     def full_signature(self) -> MultiSignature | None:
         """Registry-sized combination of everything we have (store.go:238-246)."""
@@ -251,7 +274,7 @@ class SignatureStore:
             IncomingSig(origin=-1, level=lvl, ms=ms)
             for lvl, ms in self.best_by_level.items()
         ]
-        return self.part.combine_full(sigs, self.nbs)
+        return self.part.combine_full(sigs, self.nbs, combiner=self.combiner)
 
     def values(self) -> dict[str, float]:
         """Reporter counters (report.go:80-87)."""

@@ -198,13 +198,13 @@ class Field:
         )
         return jnp.asarray(arr, jnp.uint32)
 
-    def pack_batch(self, xs, mont: bool = True) -> jnp.ndarray:
-        """`pack`, array-at-once: one bigint mulmod + `to_bytes` per element
-        and a single vectorized byte→limb reinterpretation for the whole
-        batch, instead of `_int_to_limbs`'s nlimbs shift/mask Python ops per
-        element. Bit-identical output to `pack` (property-tested); this is
-        the launch-packing hot path (models/bn254_jax.py `_pack_requests`),
-        where per-launch host cost at batch 256 is what it saves."""
+    def pack_batch_np(self, xs, mont: bool = True, out=None) -> np.ndarray:
+        """`pack_batch` stopping at the host: the (nlimbs, len(xs)) uint32
+        limb array as numpy (optionally written into a caller-owned `out`
+        buffer). The zero-copy launch packer builds signature limbs with
+        this and scatters them into its staging buffers, which reach the
+        device via ONE explicit `jax.device_put` instead of an implicit
+        per-array transfer (models/bn254_jax.py `_pack_sig_limbs`)."""
         mult = self.mont_r if mont else 1
         p = self.p
         lbytes = LIMB_BITS // 8  # LIMB_BITS is byte-aligned by construction
@@ -215,7 +215,19 @@ class Field:
         arr = np.frombuffer(buf, dtype=np.dtype(f"<u{lbytes}")).reshape(
             len(xs), self.nlimbs
         )
-        return jnp.asarray(arr.T.astype(np.uint32))
+        if out is not None:
+            out[:, : len(xs)] = arr.T
+            return out
+        return arr.T.astype(np.uint32)
+
+    def pack_batch(self, xs, mont: bool = True) -> jnp.ndarray:
+        """`pack`, array-at-once: one bigint mulmod + `to_bytes` per element
+        and a single vectorized byte→limb reinterpretation for the whole
+        batch, instead of `_int_to_limbs`'s nlimbs shift/mask Python ops per
+        element. Bit-identical output to `pack` (property-tested); this is
+        the launch-packing hot path (models/bn254_jax.py `_pack_requests`),
+        where per-launch host cost at batch 256 is what it saves."""
+        return jnp.asarray(self.pack_batch_np(xs, mont=mont))
 
     def unpack(self, limbs, mont: bool = True) -> list[int]:
         """(nlimbs, B) limb array -> list of ints (from Montgomery by default)."""

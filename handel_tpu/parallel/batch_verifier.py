@@ -370,6 +370,10 @@ class BatchVerifierService:
             self._fetching = None
 
     def values(self) -> dict[str, float]:
+        pack_ms = float(getattr(self.device, "host_pack_ms", 0.0))
+        pack_n = float(getattr(self.device, "host_pack_launches", 0))
+        disp_ms = float(getattr(self.device, "host_dispatch_ms", 0.0))
+        disp_n = float(getattr(self.device, "host_dispatch_launches", 0))
         return {
             "verifierLaunches": float(self.launches),
             "verifierCandidates": float(self.candidates),
@@ -379,11 +383,18 @@ class BatchVerifierService:
                 else 0.0
             ),
             # host cost of building device inputs (vectorized packer,
-            # models/bn254_jax.py); 0 for device stubs without the counter
-            "hostPackMs": float(getattr(self.device, "host_pack_ms", 0.0)),
-            "hostPackLaunches": float(
-                getattr(self.device, "host_pack_launches", 0)
-            ),
+            # models/bn254_jax.py); 0 for device stubs without the counter.
+            # The cumulative sums are counters; the *PerLaunch averages are
+            # declared gauges so `sim watch` / Prometheus render a stable
+            # per-launch number instead of a monotonically growing one.
+            "hostPackMs": pack_ms,
+            "hostPackLaunches": pack_n,
+            "hostPackMsPerLaunch": pack_ms / pack_n if pack_n else 0.0,
+            # the other host half of a launch: staging handoff + async
+            # kernel enqueue (host_dispatch_ms split, models/bn254_jax.py)
+            "hostDispatchMs": disp_ms,
+            "hostDispatchLaunches": disp_n,
+            "hostDispatchMsPerLaunch": disp_ms / disp_n if disp_n else 0.0,
             # resilience plane: breaker + host-failover counters
             "breakerState": {"closed": 0.0, "half-open": 0.5, "open": 1.0}[
                 self.breaker.state
@@ -398,4 +409,9 @@ class BatchVerifierService:
 
     def gauge_keys(self) -> set[str]:
         """Explicit gauge declarations (core/metrics.py is_gauge_key)."""
-        return {"verifierOccupancy", "breakerState"} | self.cache.gauge_keys()
+        return {
+            "verifierOccupancy",
+            "breakerState",
+            "hostPackMsPerLaunch",
+            "hostDispatchMsPerLaunch",
+        } | self.cache.gauge_keys()

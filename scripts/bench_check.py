@@ -38,6 +38,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SIDE_METRICS = {
     "pipelined_p50_ms": "lower",
     "host_pack_ms": "lower",
+    "host_pack_dense_ms": "lower",
+    "host_dispatch_ms": "lower",
+    "no_transfer_steady_state": "higher",
     "dedup_hit_rate": "higher",
 }
 

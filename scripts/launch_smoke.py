@@ -1,0 +1,173 @@
+"""Launch-path micro-smoke: 8 packed launches + batched combine, CPU tier.
+
+The fast-tier guard for the zero-copy dispatch path (models/bn254_jax.py):
+runs 8 packed launches through pack → rotated-staging handoff → on-device
+registry aggregation (prefix gather + hole patch), checks every aggregate
+key against the host oracle, runs the batched `combine_batch` entry against
+host pairing-library folds, then produces a fresh bench artifact carrying
+the `host_pack_ms`/`host_dispatch_ms` split (bench.py host_pipeline_bench,
+small shape) and self-tests `scripts/bench_check.py --dry-run` against it —
+so the perf gate covers the dispatch split from day one.
+
+Scope note: on one CPU core the pairing-tail kernels take minutes of XLA
+each, so this smoke drives the AGGREGATION stage of the verify path — the
+stage that consumes the registry/prefix residents and the staged launch
+inputs; the identical staged arrays feed the pairing tail, which the slow
+tier compiles and checks end to end (tests/test_bn254_device.py). Expected
+wall: ~2 min of XLA compile on a cold cache, then milliseconds per launch.
+"""
+
+import json
+import os
+import random
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+from handel_tpu import native as nat  # noqa: E402
+from handel_tpu.core.bitset import BitSet  # noqa: E402
+from handel_tpu.models.bn254 import BN254PublicKey, BN254Signature  # noqa: E402
+from handel_tpu.models.bn254_jax import BN254Device  # noqa: E402
+from handel_tpu.ops import bn254_ref as bn  # noqa: E402
+
+N, C, LAUNCHES = 12, 4, 8
+
+
+def host_agg(pks, bs):
+    acc = None
+    for i in bs.indices():
+        acc = pks[i].point if acc is None else bn.g2_add(acc, pks[i].point)
+    return acc
+
+
+def main() -> int:
+    # share the persistent compile cache CI restores across runs (same dir
+    # as bench.py / the slow tier): warm pushes skip the XLA compiles
+    jax.config.update(
+        "jax_compilation_cache_dir", "/tmp/handel_tpu_jax_cache"
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    rng = random.Random(99)
+    sks = [rng.randrange(1, 1 << 20) for _ in range(N)]
+    pks = [BN254PublicKey(p) for p in nat.g2_mul_batch([bn.G2_GEN] * N, sks)]
+    device = BN254Device(pks, batch_size=C)
+    sig = BN254Signature(bn.G1_GEN)
+
+    # warm the miss_k=8 aggregation class once so the 8 timed launches
+    # measure steady state, not the cold XLA compile
+    warm_bs = BitSet(N)
+    for i in range(4):
+        warm_bs.set(i, True)
+    plan = device._pack_requests([(warm_bs, sig)])
+    jax.block_until_ready(
+        device._range_agg_kernel(plan.miss_k)(*device._stage_plan(plan)[:4])
+    )
+    device.reset_host_counters()
+
+    # -- 8 packed launches through the staged aggregation path -------------
+    t0 = time.perf_counter()
+    checked = 0
+    for launch in range(LAUNCHES):
+        reqs = []
+        for _ in range(C):
+            size = rng.randrange(2, N)
+            lo = rng.randrange(0, N - size + 1)
+            holes = set(
+                rng.sample(range(lo + 1, lo + size - 1), min(2, size - 2))
+            )
+            bs = BitSet(N)
+            for i in range(lo, lo + size):
+                if i not in holes:
+                    bs.set(i, True)
+            reqs.append((bs, sig))
+        tp = time.perf_counter()
+        plan = device._pack_requests(reqs)
+        td = time.perf_counter()
+        device.host_pack_ms += (td - tp) * 1000.0
+        device.host_pack_launches += 1
+        args = device._stage_plan(plan)
+        agg = device._range_agg_kernel(plan.miss_k)(*args[:4])
+        device.host_dispatch_ms += (time.perf_counter() - td) * 1000.0
+        device.host_dispatch_launches += 1
+        x, y, inf = device.curves.g2.to_affine(agg)
+        xs = device.curves.T.f2_unpack(x)
+        ys = device.curves.T.f2_unpack(y)
+        infs = np.asarray(inf)
+        for j, (bs, _) in enumerate(reqs):
+            want = host_agg(pks, bs)
+            got = None if infs[j] else (xs[j], ys[j])
+            assert got == want, f"launch {launch} lane {j}: aggregate mismatch"
+            checked += 1
+    assert device.host_pack_launches == LAUNCHES
+    assert device.host_dispatch_ms > 0.0
+    print(
+        f"launch_smoke: {LAUNCHES} launches, {checked} aggregates verified "
+        f"against the host oracle in {time.perf_counter() - t0:.1f}s "
+        f"(pack {device.host_pack_ms / LAUNCHES:.3f} ms/launch, dispatch "
+        f"{device.host_dispatch_ms / LAUNCHES:.3f} ms/launch)"
+    )
+
+    # -- batched combine vs host pairing-library folds ---------------------
+    pts = [bn.g1_mul(bn.G1_GEN, rng.randrange(1, bn.R)) for _ in range(8)]
+    groups = [
+        [rng.choice(pts) for _ in range(rng.randrange(2, 7))]
+        for _ in range(2 * C)
+    ]
+    got = device.combine_batch(groups)
+    for g, out in zip(groups, got):
+        acc = g[0]
+        for p in g[1:]:
+            acc = bn.g1_add(acc, p)
+        assert out == acc, "combine_batch mismatch vs host fold"
+    print(f"launch_smoke: combine_batch verified on {len(groups)} groups")
+
+    # -- bench_check --dry-run over a fresh artifact with the new split ----
+    from bench import host_pipeline_bench
+
+    fresh = {
+        "metric": f"{N}sig_launch_smoke_p50_ms",
+        "value": round(device.host_pack_ms / LAUNCHES, 3),
+        "unit": "ms",
+        "backend": jax.default_backend(),
+        **host_pipeline_bench(n_registry=64, lanes=8, trials=5),
+    }
+    assert "host_dispatch_ms" in fresh and fresh["host_dispatch_ms"] >= 0.0
+    assert fresh["no_transfer_steady_state"] == 1.0, (
+        "steady-state staging performed an implicit host->device transfer"
+    )
+    with tempfile.NamedTemporaryFile("w", suffix=".json", delete=False) as f:
+        json.dump(fresh, f)
+        path = f.name
+    try:
+        r = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(REPO, "scripts", "bench_check.py"),
+                "--dry-run",
+                "--fresh",
+                path,
+            ],
+            capture_output=True,
+            text=True,
+        )
+        sys.stdout.write(r.stdout)
+        sys.stderr.write(r.stderr)
+        assert r.returncode == 0, "bench_check --dry-run failed"
+        assert "host_dispatch_ms" in r.stdout, (
+            "bench_check did not consider host_dispatch_ms"
+        )
+    finally:
+        os.unlink(path)
+    print("launch_smoke: bench_check --dry-run gated the dispatch split")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -56,19 +56,53 @@ def _rand_request(rng, kind):
     return (bs, BN254Signature(bn.G1_GEN))
 
 
+def _mask_of(plan):
+    """Dense candidate mask of a plan in (n, C) layout, whichever source
+    the plan carries: the loop oracle's host-built `mask`, or the
+    vectorized plan's packed `words` (the device-transfer source — the
+    kernel unpacks it on device with the same bit semantics)."""
+    if plan.mask is not None:
+        return np.asarray(plan.mask)
+    bits = np.unpackbits(
+        np.asarray(plan.words).view(np.uint8),
+        axis=1,
+        count=N,
+        bitorder="little",
+    ).view(np.bool_)
+    return (bits & np.asarray(plan.valid)[:, None]).T
+
+
 def _assert_plans_equal(a, b, ctx):
     assert a.kind == b.kind, ctx
     assert a.miss_k == b.miss_k, ctx
-    for f in ("lo", "hi", "miss_idx", "miss_ok", "mask", "valid"):
+    for f in ("lo", "hi", "miss_idx", "miss_ok", "valid"):
         x, y = getattr(a, f), getattr(b, f)
         assert (x is None) == (y is None), (ctx, f)
         if x is not None:
             x, y = np.asarray(x), np.asarray(y)
             assert x.dtype == y.dtype, (ctx, f, x.dtype, y.dtype)
             assert x.shape == y.shape and (x == y).all(), (ctx, f)
+    if a.kind == "dense":
+        ma, mb = _mask_of(a), _mask_of(b)
+        assert ma.shape == mb.shape and (ma == mb).all(), (ctx, "mask")
     for f in ("sig_x", "sig_y"):
         x, y = np.asarray(getattr(a, f)), np.asarray(getattr(b, f))
         assert x.dtype == y.dtype and (x == y).all(), (ctx, f)
+
+
+_SNAP_FIELDS = ("lo", "hi", "miss_idx", "miss_ok", "words", "mask", "valid",
+                "sig_x", "sig_y")
+
+
+def _snap(plan):
+    """Deep-copy a plan out of its staging views."""
+    return plan._replace(
+        **{
+            f: np.asarray(getattr(plan, f)).copy()
+            for f in _SNAP_FIELDS
+            if getattr(plan, f) is not None
+        }
+    )
 
 
 def test_pack_requests_matches_loop_property(device):
@@ -81,18 +115,44 @@ def test_pack_requests_matches_loop_property(device):
             _rand_request(rng, rng.choice(kinds))
             for _ in range(rng.randrange(1, C + 1))
         ]
-        vec = device._pack_requests(reqs)
-        # the vectorized plan views reused staging buffers: snapshot before
-        # anything else can touch them
-        vec = vec._replace(
-            **{
-                f: np.asarray(getattr(vec, f)).copy()
-                for f in ("lo", "hi", "miss_idx", "miss_ok", "mask", "valid")
-                if getattr(vec, f) is not None
-            }
-        )
+        vec = _snap(device._pack_requests(reqs))
         loop = device._pack_requests_loop(reqs)
         _assert_plans_equal(vec, loop, trial)
+
+
+def test_pack_requests_rotation_boundary_property(device):
+    """The double-buffered staging contract: across streams of consecutive
+    launches, a plan's views must stay bit-identical to the loop oracle
+    until the rotation wraps back onto its staging set — i.e. plan k is
+    still valid while plan k+1 is packed, and is only invalidated by plan
+    k + stage_sets. Verification is deliberately DEFERRED one launch: plan
+    k is checked against the oracle after pack k+1 ran, unsnapshotted, so
+    any buffer sharing between adjacent launches would corrupt it."""
+    rng = random.Random(41)
+    kinds = ["empty", "nosig", "range8", "range64", "dense"]
+    assert device.stage_sets >= 2  # the contract under test
+    for trial in range(25):
+        streams = [
+            [
+                _rand_request(rng, rng.choice(kinds))
+                for _ in range(rng.randrange(1, C + 1))
+            ]
+            for _ in range(3 + trial % 3)  # >= 3 consecutive launches
+        ]
+        prev = None  # (reqs, live unsnapshotted plan)
+        for reqs in streams:
+            plan = device._pack_requests(reqs)
+            if prev is not None:
+                # the PREVIOUS plan's views survived this pack (other set)
+                _assert_plans_equal(
+                    _snap(prev[1]),
+                    device._pack_requests_loop(prev[0]),
+                    trial,
+                )
+            prev = (reqs, plan)
+        _assert_plans_equal(
+            _snap(prev[1]), device._pack_requests_loop(prev[0]), trial
+        )
 
 
 def test_pack_requests_class_selection(device):

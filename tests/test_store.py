@@ -115,3 +115,93 @@ def test_highest_tracking():
     store.store(inc(1, [0], 1))
     store.store(inc(3, [0], 4))
     assert store.highest == 3
+
+
+class _CountingSig(FakeSignature):
+    """FakeSignature tagging each point with an int so batched combines can
+    be checked for exact membership (sum of tags, order-free)."""
+
+    __slots__ = ("tag",)
+
+    def __init__(self, tag=0):
+        super().__init__(True)
+        self.tag = tag
+
+    def combine(self, other):
+        out = _CountingSig(self.tag + other.tag)
+        return out
+
+
+def _batched_combiner(log):
+    def combiner(parts):
+        log.append(sorted(p.tag for p in parts))
+        out = _CountingSig(sum(p.tag for p in parts))
+        return out
+
+    return combiner
+
+
+def test_check_merge_single_batched_combine():
+    """A disjoint merge with individual-sig patches issues ONE combiner
+    call carrying every contribution (new sig + current best + patches),
+    and the result matches the serial reference path."""
+    part = BinomialPartitioner(1, fake_registry(8))
+    log = []
+    store = SignatureStore(part, combiner=_batched_combiner(log))
+    serial = SignatureStore(part)
+
+    def feed(s):
+        # level 3 of id=1 covers 4 ids: [4,8); build the same stream twice.
+        # The final replace ({1,3} vs best {0,1,2}) patches holes 0 and 2
+        # with individuals recorded (but not merged) earlier — a THREE-part
+        # combine the batched path must issue as one call.
+        for bits, ind, tag in [
+            ([0, 1], False, 3),  # initial best
+            ([0], True, 1),      # overlaps best: recorded only
+            ([1, 2], False, 5),  # replace, patched with ind 0
+            ([2], True, 7),      # overlaps best: recorded only
+            ([1, 3], False, 11),  # replace, patched with inds 0 AND 2
+        ]:
+            bs = BitSet(4)
+            for b in bits:
+                bs.set(b)
+            ms = MultiSignature(bs, _CountingSig(tag))
+            s.store(
+                IncomingSig(
+                    origin=0,
+                    level=3,
+                    ms=ms,
+                    is_ind=ind,
+                    mapped_index=bits[0],
+                )
+            )
+
+    feed(store)
+    feed(serial)
+    assert store.best(3).bitset.indices() == serial.best(3).bitset.indices()
+    assert store.best(3).signature.tag == serial.best(3).signature.tag
+    # the final replace (new sig + two individual patches) was ONE batched
+    # call with all its parts
+    assert log and log[-1] == [1, 7, 11]
+
+
+def test_combined_uses_batched_combiner():
+    """store.combined()/full_signature() route the per-level fold through
+    the combiner in one call."""
+    part = BinomialPartitioner(1, fake_registry(8))
+    log = []
+    store = SignatureStore(part, combiner=_batched_combiner(log))
+    for lvl in (1, 2, 3):
+        bs = BitSet(part.size_of(lvl))
+        bs.set(0)
+        store.store(
+            IncomingSig(
+                origin=0,
+                level=lvl,
+                ms=MultiSignature(bs, _CountingSig(10**lvl)),
+            )
+        )
+    log.clear()
+    ms = store.full_signature()
+    assert ms is not None and ms.signature.tag == 10 + 100 + 1000
+    assert len(log) == 1 and len(log[0]) == 3
