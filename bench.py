@@ -299,6 +299,66 @@ def host_pipeline_bench(
     }
 
 
+def service_bench(
+    sessions: int = 8,
+    nodes: int = 16,
+    batch_size: int = 64,
+    timeout_s: float = 120.0,
+) -> dict:
+    """Multi-tenant service sustained rate (ROADMAP item 3): K concurrent
+    fake-crypto sessions share one BatchVerifierService and its coalesced
+    launches; reports sustained completed aggregations per second, the p99
+    session-completion latency under that concurrency, and the per-launch
+    lane fill ratio the cross-session coalescing achieves. Protocol-layer
+    and backend-independent (no kernels) — the 64x128 capture form runs
+    through `sim serve` (results/handel_service_64.json); this in-bench
+    shape keeps the metric fresh every round without minutes of wall.
+    Returns {aggregates_per_s, session_p99_s, launch_fill_ratio}.
+    """
+    import asyncio
+
+    from handel_tpu.service.driver import MultiSessionCluster
+
+    async def go():
+        cluster = MultiSessionCluster(
+            sessions, nodes, batch_size=batch_size
+        )
+        try:
+            return await cluster.run(timeout_s)
+        finally:
+            cluster.stop()
+
+    summary = asyncio.run(go())
+    if summary["completed"] != sessions:
+        # a partial run must not publish a flattering rate
+        print(
+            f"bench: service bench completed {summary['completed']}/"
+            f"{sessions} sessions",
+            file=sys.stderr,
+        )
+        return {}
+    return {
+        "aggregates_per_s": summary["aggregates_per_s"],
+        "session_p99_s": summary["session_p99_s"],
+        "launch_fill_ratio": summary["launch_fill_ratio"],
+    }
+
+
+def _service_metrics() -> dict:
+    """service_bench behind the degrade-don't-die contract (+ a shape
+    override for tests: HANDEL_TPU_BENCH_SERVICE_SHAPE =
+    'sessions,nodes,batch')."""
+    shape = os.environ.get("HANDEL_TPU_BENCH_SERVICE_SHAPE")
+    try:
+        if shape:
+            sessions, nodes, batch = (int(x) for x in shape.split(","))
+            return service_bench(sessions, nodes, batch)
+        return service_bench()
+    except Exception as e:
+        print(f"bench: service bench failed: {e}", file=sys.stderr)
+        return {}
+
+
 def _host_metrics() -> dict:
     """host_pipeline_bench behind the bench's degrade-don't-die contract
     (+ a shape override for tests: HANDEL_TPU_BENCH_HOST_SHAPE =
@@ -658,6 +718,9 @@ def _measure() -> None:
         # host half of the pipeline: packing + dedup metrics (host-side,
         # backend-independent — measured in-process, no extra launches)
         line.update(_host_metrics())
+        # multi-tenant service plane: sustained aggregates/s + p99 session
+        # completion + coalesced launch fill (protocol-layer, no kernels)
+        line.update(_service_metrics())
 
         def persist(extra_line: dict) -> None:
             # provenance so a later tunnel outage can't erase the capture
@@ -721,6 +784,7 @@ def _measure() -> None:
             "reference 4000-sig headline",
         }
         line.update(_host_metrics())
+        line.update(_service_metrics())
         _emit(line)
 
 

@@ -89,6 +89,14 @@ class Config:
     # under its own id as the Chrome-trace tid.
     recorder: Optional[object] = None
 
+    # -- multi-tenant service (handel_tpu/service/) ------------------------
+    # aggregation-session id this node belongs to ("" = the single-tenant
+    # default). Scopes the per-instance state — dedup verdict keys, the
+    # shared verifier's fairness/admission queues, penalty attribution —
+    # so N concurrent sessions sharing one process/device plane never
+    # bleed state into each other.
+    session: str = ""
+
     # -- TPU batch plane ---------------------------------------------------
     # max candidates per device verification launch
     batch_size: int = DEFAULT_BATCH_SIZE

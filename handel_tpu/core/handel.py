@@ -253,6 +253,7 @@ class Handel:
             logger=self.log,
             recorder=self.rec,
             trace_tid=self._tid,
+            session=self.c.session,
         )
         self.net.register_listener(self)
         self.timeout = (

@@ -215,6 +215,43 @@ class _ReporterCollector:
             yield fam
 
 
+class _LabeledReporterCollector:
+    """Bridges a `labeled_values()` reporter — {label value: {key: val}} —
+    into families carrying a label DIMENSION (one family per key, one
+    sample per label value). The multi-tenant service plane uses it with
+    label="session": `handel_service_pending{session="s3"} 17`."""
+
+    def __init__(self, plane, reporter, label, labels, gauges):
+        self.plane = plane
+        self.reporter = reporter
+        self.label = label
+        self.labels = dict(labels or {})
+        self._explicit = set(gauges) if gauges is not None else None
+
+    def _gauge_set(self):
+        if self._explicit is not None:
+            return self._explicit
+        gk = getattr(self.reporter, "gauge_keys", None)
+        return set(gk()) if callable(gk) else set()
+
+    def collect(self) -> Iterable[Family]:
+        declared = self._gauge_set()
+        fams: dict[str, Family] = {}
+        for lv, vals in dict(self.reporter.labeled_values()).items():
+            for k, v in dict(vals).items():
+                name = metric_name(self.plane, k)
+                fam = fams.get(name)
+                if fam is None:
+                    mtype = (
+                        "gauge" if is_gauge_key(k, declared) else "counter"
+                    )
+                    fam = fams[name] = Family(name, mtype)
+                fam.samples.append(
+                    Sample({**self.labels, self.label: str(lv)}, v)
+                )
+        yield from fams.values()
+
+
 class _HistogramReporterCollector:
     """Bridges a `histograms()` reporter (key -> LogHistogram)."""
 
@@ -257,6 +294,18 @@ class MetricsRegistry:
         come from `gauges`, else the reporter's own `gauge_keys()`, else the
         suffix fallback."""
         self.register(_ReporterCollector(plane, reporter, labels, gauges))
+
+    def register_labeled_values(self, plane: str, reporter,
+                                label: str = "session",
+                                labels: Mapping[str, str] | None = None,
+                                gauges: Iterable[str] | None = None) -> None:
+        """Expose a `labeled_values()` reporter ({label value: {key: v}})
+        under `handel_<plane>_*` with `label` as a label dimension — the
+        session axis of the multi-tenant service. Gauge classification as
+        in register_values."""
+        self.register(
+            _LabeledReporterCollector(plane, reporter, label, labels, gauges)
+        )
 
     def register_histograms(self, plane: str, reporter,
                             labels: Mapping[str, str] | None = None) -> None:

@@ -223,6 +223,7 @@ class BatchProcessing:
         logger: Logger = DEFAULT_LOGGER,
         recorder=None,
         trace_tid: int = 0,
+        session: str = "",
     ):
         self.part = part
         self.cons = constructor
@@ -241,6 +242,12 @@ class BatchProcessing:
         # verification FAILED, so the node can penalize the packet origin
         # (core/penalty.py via Handel._on_verify_failed)
         self.on_verify_failed = on_verify_failed
+        # multi-tenant scope (handel_tpu/service/): a non-empty session id
+        # prefixes every dedup key below, so a cache shared across
+        # sessions — or a future shared per-committee cache — can never
+        # hand one tenant another tenant's verdict. "" keeps the
+        # single-tenant key shape byte-for-byte.
+        self.session = session
         # verified-aggregate dedup: Handel re-receives the same winning
         # aggregate from several peers per level; each copy this node has
         # already judged short-circuits here instead of burning a device lane
@@ -440,7 +447,8 @@ class BatchProcessing:
         first_at: dict[tuple, int] = {}
         to_verify: list[int] = []
         for i, sp in enumerate(batch):
-            k = VerifiedAggCache.key(sp.level, sp.ms)
+            scope = (self.session, sp.level) if self.session else sp.level
+            k = VerifiedAggCache.key(scope, sp.ms)
             keys.append(k)
             if k in first_at:
                 self.dedup.hits += 1  # in-batch duplicate: zero extra lanes

@@ -59,10 +59,29 @@ class VerifiedAggCache:
         self.misses = 0
 
     @staticmethod
-    def key(scope: int | bytes, ms: MultiSignature) -> tuple:
-        """Content identity of a candidate: scope (level or message),
+    def key(scope, ms: MultiSignature) -> tuple:
+        """Content identity of a candidate: scope (level, message, or a
+        (session, level) pair — the multi-tenant service prepends the
+        session id so identical bytes in two sessions never cross-dedup),
         exact bitset words, exact signature bytes."""
         return (scope, ms.bitset.words().tobytes(), ms.signature.marshal())
+
+    def drop_scope(self, scope) -> int:
+        """Forget every verdict whose key LEADS with `scope` — either as
+        the key's first element or as the first element of a tuple scope.
+        The multi-tenant eviction hook (handel_tpu/service/): a retired
+        session's verdicts must not keep occupying LRU capacity the live
+        tenants could use. O(cache size) — evictions are rare next to
+        lookups. Returns the number of entries dropped."""
+        dead = [
+            k
+            for k in self._map
+            if k[0] == scope
+            or (isinstance(k[0], tuple) and k[0] and k[0][0] == scope)
+        ]
+        for k in dead:
+            del self._map[k]
+        return len(dead)
 
     def get(self, key: tuple) -> bool | None:
         """Remembered verdict for `key`, or None; counts the hit/miss."""

@@ -177,6 +177,10 @@ class BN254Device:
         # profiling the aggregation stage standalone on one device
         self._range_agg_kernels: dict[int, callable] = {}
         self._h_cache: dict[bytes, tuple] = {}
+        # host-side H(m) limb columns + launch counter for the per-lane-h
+        # multi-message path (dispatch_multi)
+        self._h_np_cache: dict[bytes, tuple] = {}
+        self.multi_msg_launches = 0
         # prefix table: slot i = sum of registry keys [0, i) in affine, with
         # an explicit infinity flag (slot 0). Built lazily on the first
         # range-path dispatch (dense-only users never pay the scan); after
@@ -545,8 +549,14 @@ class BN254Device:
             None if infs[j] else (xs[j], ys[j]) for j in range(len(groups))
         ]
 
-    def warmup(self) -> int:
+    def warmup(self, multi_msg: bool = False) -> int:
         """Compile every kernel a verification round can reach, up front.
+
+        `multi_msg=True` additionally compiles the per-lane-h variant of
+        the common range class (the `dispatch_multi` shape a multi-tenant
+        service reaches once sessions with distinct messages coalesce into
+        one launch) — off by default because single-tenant runs never hit
+        it and each variant is a full pairing-graph compile.
 
         Dispatches one synthetic launch per reachable input class — range
         kernel at miss_k=8, range kernel at miss_k=64, dense fallback — so
@@ -574,6 +584,19 @@ class BN254Device:
             for i in signers:
                 bs.set(i, True)
             self.fetch(self.dispatch(b"bn254-device-warmup", [(bs, sig)]))
+            launches += 1
+        if multi_msg and self.n >= 2:
+            bs1, bs2 = BitSet(self.n), BitSet(self.n)
+            bs1.set(0, True)
+            bs2.set(1, True)
+            self.fetch(
+                self.dispatch_multi(
+                    [
+                        (b"bn254-device-warmup-m1", None, bs1, sig),
+                        (b"bn254-device-warmup-m2", None, bs2, sig),
+                    ]
+                )
+            )
             launches += 1
         # combine classes k=2/4/8 cover pairwise merges through wide patch
         # chains (point adds only — seconds each, not a pairing graph);
@@ -841,15 +864,12 @@ class BN254Device:
             dp(plan.valid),
         )
 
-    def _dispatch_one(self, msg, requests):
-        t0 = time.perf_counter()
-        plan = self._pack_requests(requests)
-        t1 = time.perf_counter()
-        self.host_pack_ms += (t1 - t0) * 1000.0
-        self.host_pack_launches += 1
-        h_x, h_y = self._h_point(msg)
-        staged = self._stage_plan(plan)
-
+    def _run_plan(self, plan, staged, h_x, h_y):
+        """Launch one staged plan against the kernels. h_x/h_y may be the
+        cached per-message (L, 1) arrays (broadcast across lanes) or the
+        multi-message (L, C) per-lane columns — the kernels broadcast to
+        the signature shape either way, so both shapes share the math; XLA
+        compiles one extra variant per kernel class for the wide shape."""
         # Handel candidates are partitioner ID ranges with few holes: the
         # prefix-table fast path; the dense kernel is the arbitrary-set
         # fallback (plan.kind decides, same classes as always)
@@ -859,51 +879,54 @@ class BN254Device:
                 agg = self._range_agg_kernel(plan.miss_k)(
                     lo, hi, miss_idx, miss_ok
                 )
-                verdicts = self._sharded_tail(
-                    agg, sig_x, sig_y, h_x, h_y, valid
+                return self._sharded_tail(agg, sig_x, sig_y, h_x, h_y, valid)
+            return self._range_kernel(plan.miss_k)(
+                lo, hi, miss_idx, miss_ok, sig_x, sig_y, h_x, h_y, valid
+            )
+        words32, sig_x, sig_y, valid = staged
+        if self.mesh is not None:
+            # the staged sharded pipeline still wants the dense (n, C)
+            # mask; unpack it host-side here — the mesh path's host glue
+            # already materializes per-stage arrays, so this is not the
+            # single-chip hot path
+            mask = (
+                np.unpackbits(
+                    plan.words.view(np.uint8),
+                    axis=1,
+                    count=self.n,
+                    bitorder="little",
                 )
-            else:
-                verdicts = self._range_kernel(plan.miss_k)(
-                    lo, hi, miss_idx, miss_ok, sig_x, sig_y, h_x, h_y, valid
-                )
-        else:
-            words32, sig_x, sig_y, valid = staged
-            if self.mesh is not None:
-                # the staged sharded pipeline still wants the dense (n, C)
-                # mask; unpack it host-side here — the mesh path's host glue
-                # already materializes per-stage arrays, so this is not the
-                # single-chip hot path
-                mask = (
-                    np.unpackbits(
-                        plan.words.view(np.uint8),
-                        axis=1,
-                        count=self.n,
-                        bitorder="little",
-                    )
-                    .view(np.bool_)
-                    .T.copy()
-                )
-                agg = self._sharded_sum(
-                    self._reg_x[0],
-                    self._reg_x[1],
-                    self._reg_y[0],
-                    self._reg_y[1],
-                    jnp.asarray(mask),
-                )
-                verdicts = self._sharded_tail(
-                    agg, sig_x, sig_y, h_x, h_y, valid
-                )
-            else:
-                verdicts = self._kernel(
-                    self._reg_x,
-                    self._reg_y,
-                    words32,
-                    sig_x,
-                    sig_y,
-                    h_x,
-                    h_y,
-                    valid,
-                )
+                .view(np.bool_)
+                .T.copy()
+            )
+            agg = self._sharded_sum(
+                self._reg_x[0],
+                self._reg_x[1],
+                self._reg_y[0],
+                self._reg_y[1],
+                jnp.asarray(mask),
+            )
+            return self._sharded_tail(agg, sig_x, sig_y, h_x, h_y, valid)
+        return self._kernel(
+            self._reg_x,
+            self._reg_y,
+            words32,
+            sig_x,
+            sig_y,
+            h_x,
+            h_y,
+            valid,
+        )
+
+    def _dispatch_one(self, msg, requests):
+        t0 = time.perf_counter()
+        plan = self._pack_requests(requests)
+        t1 = time.perf_counter()
+        self.host_pack_ms += (t1 - t0) * 1000.0
+        self.host_pack_launches += 1
+        h_x, h_y = self._h_point(msg)
+        staged = self._stage_plan(plan)
+        verdicts = self._run_plan(plan, staged, h_x, h_y)
         if isinstance(verdicts, jax.Array):
             # fence the staging set this launch reads: _pack_requests blocks
             # on it before the rotation wraps back onto these buffers
@@ -911,6 +934,76 @@ class BN254Device:
         self.host_dispatch_ms += (time.perf_counter() - t1) * 1000.0
         self.host_dispatch_launches += 1
         return verdicts
+
+    # -- multi-message launches (multi-tenant service coalescing) -----------
+
+    def _h_cols(self, msg: bytes):
+        """Host-side (L, 1) limb columns of H(msg) — the np counterpart of
+        `_h_point`'s device-resident cache, kept separately so building a
+        per-lane h matrix never pulls a device array back to the host."""
+        cached = self._h_np_cache.get(msg)
+        if cached is None:
+            h = self._hash_to_g1(msg)
+            F = self.curves.F
+            cached = (F.pack_batch_np([h[0]]), F.pack_batch_np([h[1]]))
+            self._h_np_cache[msg] = cached
+        return cached
+
+    def _h_lanes(self, msgs):
+        """(L, C) per-lane H(m) arrays for a mixed-message launch, built by
+        scattering the per-distinct-message columns (hash-to-curve runs
+        once per distinct message, cached) and explicitly device_put —
+        the same staging discipline as `_stage_plan`."""
+        C = self.batch_size
+        uniq: dict[bytes, int] = {}
+        inv = np.empty((len(msgs),), np.int64)
+        cols: list[tuple] = []
+        for j, m in enumerate(msgs):
+            i = uniq.get(m)
+            if i is None:
+                i = uniq[m] = len(cols)
+                cols.append(self._h_cols(m))
+            inv[j] = i
+        hx = np.concatenate([c[0] for c in cols], axis=1)[:, inv]
+        hy = np.concatenate([c[1] for c in cols], axis=1)[:, inv]
+        if len(msgs) < C:
+            # padded lanes are masked invalid; any finite h keeps the math
+            # well-defined, so repeat the last real column
+            hx = np.concatenate(
+                [hx, np.repeat(hx[:, -1:], C - len(msgs), axis=1)], axis=1
+            )
+            hy = np.concatenate(
+                [hy, np.repeat(hy[:, -1:], C - len(msgs), axis=1)], axis=1
+            )
+        return jax.device_put(hx), jax.device_put(hy)
+
+    def dispatch_multi(self, items):
+        """Enqueue one launch whose lanes may carry DIFFERENT messages —
+        the multi-tenant service's cross-session coalescing contract
+        (parallel/batch_verifier.py): items are (msg, pubkeys, bitset,
+        sig); pubkeys are ignored because this device's resident registry
+        is the key universe for every lane. A uniform-message batch
+        delegates to the ordinary `dispatch` (cached (L, 1) h, no extra
+        kernel variant); mixed messages stage per-lane (L, C) h columns
+        into the same kernels. Returns a `fetch`-compatible handle."""
+        msgs = [it[0] for it in items]
+        reqs = [(it[2], it[3]) for it in items]
+        if len(set(msgs)) <= 1:
+            return self.dispatch(msgs[0] if msgs else b"", reqs)
+        t0 = time.perf_counter()
+        plan = self._pack_requests(reqs)
+        t1 = time.perf_counter()
+        self.host_pack_ms += (t1 - t0) * 1000.0
+        self.host_pack_launches += 1
+        h_x, h_y = self._h_lanes(msgs)
+        staged = self._stage_plan(plan)
+        verdicts = self._run_plan(plan, staged, h_x, h_y)
+        if isinstance(verdicts, jax.Array):
+            self._stage[self._stage_idx].fence = verdicts
+        self.host_dispatch_ms += (time.perf_counter() - t1) * 1000.0
+        self.host_dispatch_launches += 1
+        self.multi_msg_launches += 1
+        return (verdicts, len(reqs))
 
 
 class BN254JaxConstructor(BN254Constructor):

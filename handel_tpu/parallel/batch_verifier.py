@@ -7,6 +7,19 @@ serially on its own goroutine. Here all co-located nodes funnel their
 pads to the device batch size, and issues a single multi-pairing launch —
 the device equivalent of a shared syscall batcher. This is the prerequisite
 for single-host thousands-of-nodes simulation (VERDICT r1 item 9).
+
+Multi-tenant extension (ROADMAP item 3, handel_tpu/service/): requests are
+tagged with the aggregation SESSION they belong to. A deficit-round-robin
+`TenantQueue` (service/fairness.py) replaces the single FIFO, so N
+concurrent Handel sessions share the device plane without a hot session
+starving the rest, and one coalesced launch fills its 64/128 lanes from
+whichever sessions have pending work. Devices exposing `dispatch_multi`
+(per-lane messages — models/bn254_jax.py, or the host adapter in
+service/driver.py) take the whole mixed-session batch as ONE launch;
+legacy single-message devices fall back to one launch per distinct
+message. Dedup verdicts are keyed per session: the same aggregate content
+seen by two different sessions is two different facts (different
+committees/rounds), never cross-deduped.
 """
 
 from __future__ import annotations
@@ -20,6 +33,7 @@ from handel_tpu.core.logging import DEFAULT_LOGGER, Logger
 from handel_tpu.core.store import VerifiedAggCache
 from handel_tpu.core.trace import SERVICE_TID, trace_now
 from handel_tpu.models.bn254_jax import BN254Device
+from handel_tpu.service.fairness import TenantQueue
 from handel_tpu.utils.breaker import CircuitBreaker
 
 __all__ = ["BatchVerifierService", "CircuitBreaker"]
@@ -31,20 +45,28 @@ __all__ = ["BatchVerifierService", "CircuitBreaker"]
 # (core/crypto.py Constructor.batch_verify -> ops/bn254_ref math).
 FallbackVerifier = Callable[[bytes, Sequence[tuple[BitSet, object]]], list]
 
+# queued-request tuple layout (one flat tuple, future LAST — every consumer
+# below indexes it positionally): (session, msg, pubkeys, bitset, sig, fut)
+_SESSION, _MSG, _PUBKEYS, _BITSET, _SIG, _FUT = range(6)
+
 
 class BatchVerifierService:
     """Fuses verify requests from any number of nodes into shared launches.
 
-    Wire into every node's Config.verifier via `.verifier`. Requests are
-    answered with per-candidate verdicts; the collector waits up to
-    `max_delay_ms` to fill a batch (latency/occupancy tradeoff knob).
+    Wire into every node's Config.verifier via `.verifier` (or a
+    session-tagged wrapper from `session_verifier`). Requests are answered
+    with per-candidate verdicts; the collector waits up to `max_delay_ms`
+    to fill a batch (latency/occupancy tradeoff knob).
 
-    Process-wide dedup: co-located nodes all receive (and would all verify)
-    the same winning aggregate per level. Requests are keyed by exact
-    content — (msg, bitset words, signature bytes) — against a shared
-    `VerifiedAggCache`, so a candidate ANY co-located node already verified
-    resolves instantly, and concurrent duplicates coalesce onto the one
-    in-flight copy's lane instead of each taking their own.
+    Per-session dedup: co-located nodes of ONE session all receive (and
+    would all verify) the same winning aggregate per level. Requests are
+    keyed by exact content — (session, msg, bitset words, signature bytes)
+    — against a shared `VerifiedAggCache`, so a candidate ANY co-located
+    node of that session already verified resolves instantly, and
+    concurrent duplicates coalesce onto the one in-flight copy's lane
+    instead of each taking their own. The session id in the key is the
+    tenant-isolation boundary: identical bytes in two sessions stay two
+    verifications.
     """
 
     def __init__(
@@ -60,6 +82,8 @@ class BatchVerifierService:
         backoff_cap_s: float = 1.0,
         logger: Logger = DEFAULT_LOGGER,
         recorder=None,
+        quantum: int = 8,
+        max_pending_per_session: int = 4096,
     ):
         self.device = device
         # flight recorder (core/trace.py): dispatch-pack (host prep) and
@@ -84,23 +108,43 @@ class BatchVerifierService:
         self.device_retries = 0
         self.failover_batches = 0
         self.failover_candidates = 0
-        self._pending: list[tuple[bytes, BitSet, object, asyncio.Future]] = []
+        # tenant-tagged pending queue: per-session FIFOs drained
+        # deficit-round-robin so one hot session cannot starve the rest.
+        # The per-tenant bound is the service-side admission control — a
+        # refused push fails that request's future immediately and the
+        # session's own pipeline absorbs it under its retry budget.
+        self.queue = TenantQueue(
+            quantum=quantum, max_pending=max_pending_per_session
+        )
         self._kick = asyncio.Event()
         self._task: asyncio.Task | None = None
         self._fetch_task: asyncio.Task | None = None
         self._fetch_q: asyncio.Queue | None = None
-        # batches held by a pipeline stage OUTSIDE _pending/_fetch_q — the
+        # batches held by a pipeline stage OUTSIDE the queue/_fetch_q — the
         # collector's dispatch-in-progress and the fetcher's fetch-in-progress
         # — so stop() can fail their waiters too (a cancelled stage would
         # otherwise strand them awaiting forever; ADVICE r5 #1)
         self._collecting: list | None = None
         self._fetching: list | None = None
-        # verified-aggregate dedup (shared across every node on this service)
+        # verified-aggregate dedup (shared across every node on this
+        # service, keyed per session)
         self.cache = dedup_cache or VerifiedAggCache(capacity=8192)
         self._inflight: dict[tuple, asyncio.Future] = {}
         # counters for the monitor plane
         self.launches = 0
         self.candidates = 0
+        # launch fill accounting (satellite fix): occupied lanes / lane
+        # capacity recorded PER DISPATCHED LAUNCH, so coalescing wins are
+        # measurable against the pre-service baseline. `launches`/
+        # `candidates` above count at fetch (verdict) time and exclude
+        # failover batches; these count at dispatch time.
+        self.fill_sum = 0.0
+        self.fill_launches = 0
+        self.last_fill = 0.0
+        self.coalesced_launches = 0  # launches mixing >1 distinct message
+        # per-tenant counters (service plane labels)
+        self.tenant_candidates: dict[str, int] = {}
+        self.tenant_dedup_hits: dict[str, int] = {}
 
     def start(self) -> None:
         loop = asyncio.get_running_loop()
@@ -117,10 +161,10 @@ class BatchVerifierService:
     def stop(self) -> None:
         """Cancel both pipeline stages and FAIL any unanswered waiters —
         dropping them would leave callers awaiting forever. That includes
-        the batch each stage holds OUTSIDE _pending/_fetch_q while it works
-        (dispatch or fetch in flight): cancelling the stage strands those
-        futures unless they are failed here. Resetting _task lets a later
-        verify() restart the service."""
+        the batch each stage holds OUTSIDE the queue/_fetch_q while it
+        works (dispatch or fetch in flight): cancelling the stage strands
+        those futures unless they are failed here. Resetting _task lets a
+        later verify() restart the service."""
         if self._task:
             self._task.cancel()
             self._task = None
@@ -131,37 +175,44 @@ class BatchVerifierService:
         if self._fetch_q is not None:
             while True:
                 try:
-                    _, _, items = self._fetch_q.get_nowait()
+                    _, items = self._fetch_q.get_nowait()
                 except asyncio.QueueEmpty:
                     break
-                for _, _, fut in items:
-                    if not fut.done():
-                        fut.set_exception(err)
+                for it in items:
+                    if not it[_FUT].done():
+                        it[_FUT].set_exception(err)
             self._fetch_q = None
         for stage in (self._collecting, self._fetching):
-            for _, _, fut in stage or ():
-                if not fut.done():
-                    fut.set_exception(err)
+            for it in stage or ():
+                if not it[_FUT].done():
+                    it[_FUT].set_exception(err)
         self._collecting = self._fetching = None
-        for _, _, _, fut in self._pending:
-            if not fut.done():
-                fut.set_exception(err)
-        self._pending.clear()
+        for it in self.queue.drain():
+            if not it[_FUT].done():
+                it[_FUT].set_exception(err)
         # coalesced duplicates chained onto a failed primary are resolved by
         # their done-callbacks when the loop next runs; nothing to do here
         self._inflight.clear()
 
-    async def verify(self, msg, pubkeys, requests) -> list[bool]:
-        """AsyncVerifier-compatible entry (core/processing.py)."""
+    async def verify(
+        self, msg, pubkeys, requests, session: str = ""
+    ) -> list[bool]:
+        """AsyncVerifier-compatible entry (core/processing.py). `session`
+        tags the requests with their aggregation instance: fairness,
+        dedup scope and queue bounds are all keyed by it."""
         if self._task is None:
             self.start()
         loop = asyncio.get_running_loop()
         futs = []
         for bs, sig in requests:
-            key = (msg, bs.words().tobytes(), sig.marshal())
+            key = (session, msg, bs.words().tobytes(), sig.marshal())
             cached = self.cache.get(key)
             if cached is not None:
-                # some co-located node already verified this exact aggregate
+                # some co-located node of this session already verified
+                # this exact aggregate
+                self.tenant_dedup_hits[session] = (
+                    self.tenant_dedup_hits.get(session, 0) + 1
+                )
                 fut = loop.create_future()
                 fut.set_result(cached)
                 futs.append(fut)
@@ -172,17 +223,60 @@ class BatchVerifierService:
                 # dedup hit for lane accounting — undo the get()'s miss count
                 self.cache.misses -= 1
                 self.cache.hits += 1
+                self.tenant_dedup_hits[session] = (
+                    self.tenant_dedup_hits.get(session, 0) + 1
+                )
                 fut = loop.create_future()
                 primary.add_done_callback(partial(self._chain, fut))
                 futs.append(fut)
                 continue
             fut = loop.create_future()
+            if not self.queue.push(
+                session, (session, msg, pubkeys, bs, sig, fut)
+            ):
+                # per-tenant admission bound: the hot session absorbs its
+                # own refusal through the pipeline's requeue/retry budget
+                fut.set_exception(
+                    RuntimeError(
+                        f"batch verifier: session {session!r} queue full"
+                    )
+                )
+                futs.append(fut)
+                continue
+            self.tenant_candidates[session] = (
+                self.tenant_candidates.get(session, 0) + 1
+            )
             self._inflight[key] = fut
             fut.add_done_callback(partial(self._uninflight, key))
-            self._pending.append((msg, bs, sig, fut))
             futs.append(fut)
         self._kick.set()
         return list(await asyncio.gather(*futs))
+
+    def session_verifier(self, session: str):
+        """A Config.verifier-shaped wrapper tagging every request with
+        `session` (the per-node pipeline's verifier contract has no session
+        argument — the tag rides the closure)."""
+
+        async def verify(msg, pubkeys, requests):
+            return await self.verify(msg, pubkeys, requests, session=session)
+
+        return verify
+
+    def forget_session(self, session: str) -> int:
+        """Drop every trace of one tenant (SessionManager evict): queued
+        requests fail immediately, dedup verdicts and counters vanish.
+        Returns the number of queued requests dropped."""
+        dropped = self.queue.drop_tenant(session)
+        err = RuntimeError(f"batch verifier: session {session!r} evicted")
+        for it in dropped:
+            if not it[_FUT].done():
+                it[_FUT].set_exception(err)
+        for key in [k for k in self._inflight if k[0] == session]:
+            self._inflight.pop(key, None)
+        self.cache.drop_scope(session)
+        self.tenant_candidates.pop(session, None)
+        self.tenant_dedup_hits.pop(session, None)
+        return len(dropped)
 
     @staticmethod
     def _chain(fut: asyncio.Future, primary: asyncio.Future) -> None:
@@ -208,29 +302,53 @@ class BatchVerifierService:
     def verifier(self):
         return self.verify
 
+    def queue_depth(self) -> int:
+        """Total queued candidates across every tenant (telemetry plane)."""
+        return len(self.queue)
+
+    def _plan_launches(self, batch: list) -> list[list]:
+        """Split one fairly-drained batch into launch groups. A device with
+        `dispatch_multi` (per-lane messages) takes the WHOLE mixed-session
+        batch as one coalesced launch; a single-message device gets one
+        launch per distinct message (the pre-service behavior)."""
+        if hasattr(self.device, "dispatch_multi"):
+            return [batch]
+        by_msg: dict[bytes, list] = {}
+        for it in batch:
+            by_msg.setdefault(it[_MSG], []).append(it)
+        return list(by_msg.values())
+
+    def _launch_call(self, items: list):
+        """The device call for one launch group (runs in an executor)."""
+        if hasattr(self.device, "dispatch_multi"):
+            return partial(
+                self.device.dispatch_multi,
+                [(it[_MSG], it[_PUBKEYS], it[_BITSET], it[_SIG])
+                 for it in items],
+            )
+        return partial(
+            self.device.dispatch,
+            items[0][_MSG],
+            [(it[_BITSET], it[_SIG]) for it in items],
+        )
+
     async def _collector(self) -> None:
         while True:
-            if not self._pending:
+            if not len(self.queue):
                 self._kick.clear()
                 await self._kick.wait()
-            # brief accumulation window so co-located nodes share the launch
-            if len(self._pending) < self.device.batch_size:
+            # brief accumulation window so co-located nodes (and sessions)
+            # share the launch
+            if len(self.queue) < self.device.batch_size:
                 await asyncio.sleep(self.max_delay)
-            batch = self._pending[: self.device.batch_size]
-            self._pending = self._pending[self.device.batch_size :]
+            batch = self.queue.take(self.device.batch_size)
             if not batch:
                 continue
             # from here until every group is handed to _fetch_q the batch
-            # lives in neither _pending nor the queue: track it on self so
+            # lives in neither the queue nor _fetch_q: track it on self so
             # stop() can fail these futures if this task is cancelled
-            self._collecting = [(bs, sig, fut) for _, bs, sig, fut in batch]
-            # group by message (one launch per distinct msg in the batch;
-            # a simulation run shares a single msg, so this is one launch)
-            by_msg: dict[bytes, list[tuple[BitSet, object, asyncio.Future]]] = {}
-            for msg, bs, sig, fut in batch:
-                by_msg.setdefault(msg, []).append((bs, sig, fut))
-            for msg, items in by_msg.items():
-                reqs = [(bs, sig) for bs, sig, _ in items]
+            self._collecting = batch
+            for items in self._plan_launches(batch):
                 handle = None
                 if self.breaker.allow():
                     # dispatch only (host prep + async enqueue) — the fetch
@@ -239,7 +357,9 @@ class BatchVerifierService:
                     # retry with capped exponential backoff; each failure
                     # feeds the breaker.
                     t0 = trace_now()
-                    handle = await self._dispatch_with_retries(msg, reqs)
+                    handle = await self._dispatch_with_retries(
+                        self._launch_call(items)
+                    )
                     if self.rec is not None and self.rec.enabled:
                         # the host half of a launch: request packing + the
                         # async enqueue (PR 1's host_pack_ms lives in here)
@@ -249,24 +369,29 @@ class BatchVerifierService:
                             trace_now(),
                             tid=SERVICE_TID,
                             cat="verifier",
-                            args={"n": len(reqs), "ok": handle is not None},
+                            args={"n": len(items), "ok": handle is not None},
                         )
                 if handle is None:
                     # breaker open, or retries exhausted: host failover
                     # (or fail the futures when no fallback exists)
-                    await self._failover(msg, items)
+                    await self._failover(items)
                     continue
-                await self._fetch_q.put((handle, msg, items))
+                # launch fill: occupied lanes over lane capacity, recorded
+                # per dispatched launch (the coalescing win metric)
+                self.last_fill = len(items) / self.device.batch_size
+                self.fill_sum += self.last_fill
+                self.fill_launches += 1
+                if len({it[_MSG] for it in items}) > 1:
+                    self.coalesced_launches += 1
+                await self._fetch_q.put((handle, items))
             self._collecting = None
 
-    async def _dispatch_with_retries(self, msg, reqs):
+    async def _dispatch_with_retries(self, call):
         """Try the device up to 1 + retry_limit times; None = gave up."""
         loop = asyncio.get_running_loop()
         for attempt in range(1 + self.retry_limit):
             try:
-                return await loop.run_in_executor(
-                    None, partial(self.device.dispatch, msg, reqs)
-                )
+                return await loop.run_in_executor(None, call)
             except asyncio.CancelledError:
                 raise  # stop() fails the futures via _collecting
             except Exception as e:
@@ -290,15 +415,17 @@ class BatchVerifierService:
                 )
         return None
 
-    async def _failover(self, msg, items) -> None:
-        """Resolve a batch through the host reference verifier; with no
-        fallback configured, fail the futures (BatchProcessing requeues the
-        candidates under its retry budget — the pre-breaker behavior)."""
+    async def _failover(self, items) -> None:
+        """Resolve a launch group through the host reference verifier; with
+        no fallback configured, fail the futures (BatchProcessing requeues
+        the candidates under its retry budget — the pre-breaker behavior).
+        A coalesced group can span messages: the (msg, reqs) fallback
+        contract is honored by resolving one message group at a time."""
         if self.fallback is None:
             err = RuntimeError("batch verifier: device unavailable")
-            for _, _, fut in items:
-                if not fut.done():
-                    fut.set_exception(err)
+            for it in items:
+                if not it[_FUT].done():
+                    it[_FUT].set_exception(err)
             return
         if self.rec is not None:
             self.rec.instant(
@@ -307,31 +434,37 @@ class BatchVerifierService:
                 cat="verifier",
                 args={"n": len(items), "breaker": self.breaker.state},
             )
-        reqs = [(bs, sig) for bs, sig, _ in items]
+        by_msg: dict[bytes, list] = {}
+        for it in items:
+            by_msg.setdefault(it[_MSG], []).append(it)
         loop = asyncio.get_running_loop()
-        try:
-            verdicts = await loop.run_in_executor(
-                None, partial(self.fallback, msg, reqs)
-            )
-        except asyncio.CancelledError:
-            raise
-        except Exception as e:
-            for _, _, fut in items:
-                if not fut.done():
-                    fut.set_exception(RuntimeError(f"batch verifier: {e}"))
-            return
-        self.failover_batches += 1
-        self.failover_candidates += len(items)
-        for (_, _, fut), ok in zip(items, verdicts):
-            if not fut.done():
-                fut.set_result(bool(ok))
+        for msg, group in by_msg.items():
+            reqs = [(it[_BITSET], it[_SIG]) for it in group]
+            try:
+                verdicts = await loop.run_in_executor(
+                    None, partial(self.fallback, msg, reqs)
+                )
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                for it in group:
+                    if not it[_FUT].done():
+                        it[_FUT].set_exception(
+                            RuntimeError(f"batch verifier: {e}")
+                        )
+                continue
+            self.failover_batches += 1
+            self.failover_candidates += len(group)
+            for it, ok in zip(group, verdicts):
+                if not it[_FUT].done():
+                    it[_FUT].set_result(bool(ok))
 
     async def _fetcher(self) -> None:
         """Second pipeline stage: pull verdicts for dispatched launches, in
         dispatch order, and resolve the waiters."""
         loop = asyncio.get_running_loop()
         while True:
-            handle, msg, items = await self._fetch_q.get()
+            handle, items = await self._fetch_q.get()
             # outside _fetch_q until resolved: visible to stop() (see
             # _collector's mirror note)
             self._fetching = items
@@ -347,7 +480,7 @@ class BatchVerifierService:
                 # the same breaker + host-failover path as dispatch errors
                 self.breaker.record_failure()
                 self.log.warn("verifier_device_error", f"fetch: {e}")
-                await self._failover(msg, items)
+                await self._failover(items)
                 self._fetching = None
                 continue
             if self.rec is not None and self.rec.enabled:
@@ -364,10 +497,28 @@ class BatchVerifierService:
             self.breaker.record_success()
             self.launches += 1
             self.candidates += len(items)
-            for (_, _, fut), ok in zip(items, verdicts):
-                if not fut.done():
-                    fut.set_result(ok)
+            for it, ok in zip(items, verdicts):
+                if not it[_FUT].done():
+                    it[_FUT].set_result(ok)
             self._fetching = None
+
+    def session_values(self) -> dict[str, dict[str, float]]:
+        """Per-tenant reporter surface for the `session`-labeled metrics
+        plane (core/metrics.py register_labeled_values): every session that
+        currently has queued work or has ever enqueued through this
+        service."""
+        depths = self.queue.depths()
+        out: dict[str, dict[str, float]] = {}
+        for sid in set(depths) | set(self.tenant_candidates):
+            out[sid] = {
+                "queueDepth": float(depths.get(sid, 0)),
+                "candidates": float(self.tenant_candidates.get(sid, 0)),
+                "dedupHits": float(self.tenant_dedup_hits.get(sid, 0)),
+            }
+        return out
+
+    def session_gauge_keys(self) -> set[str]:
+        return {"queueDepth"}
 
     def values(self) -> dict[str, float]:
         pack_ms = float(getattr(self.device, "host_pack_ms", 0.0))
@@ -382,6 +533,22 @@ class BatchVerifierService:
                 if self.launches
                 else 0.0
             ),
+            # launch fill plane (dispatch-side): per-launch occupied lanes /
+            # lane capacity — mean over every dispatched launch plus the
+            # most recent launch's fill. The coalescing win metric: a
+            # multi-session service should fill lanes the single-session
+            # baseline leaves empty.
+            "launchFillRatio": (
+                self.fill_sum / self.fill_launches if self.fill_launches
+                else 0.0
+            ),
+            "lastLaunchFill": self.last_fill,
+            "coalescedLaunches": float(self.coalesced_launches),
+            # multi-tenant plane: live tenants with queued work, total
+            # queued candidates, per-tenant admission refusals
+            "sessionsQueued": float(self.queue.tenants()),
+            "verifierQueueDepth": float(len(self.queue)),
+            "admissionRefused": float(self.queue.refused),
             # host cost of building device inputs (vectorized packer,
             # models/bn254_jax.py); 0 for device stubs without the counter.
             # The cumulative sums are counters; the *PerLaunch averages are
@@ -412,6 +579,10 @@ class BatchVerifierService:
         return {
             "verifierOccupancy",
             "breakerState",
+            "launchFillRatio",
+            "lastLaunchFill",
+            "sessionsQueued",
+            "verifierQueueDepth",
             "hostPackMsPerLaunch",
             "hostDispatchMsPerLaunch",
         } | self.cache.gauge_keys()

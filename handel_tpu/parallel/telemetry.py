@@ -131,7 +131,7 @@ class DeviceTelemetry:
                 pass  # CPU backends have no memory_stats
         svc = self.service
         if svc is not None:
-            out["dispatchQueueDepth"] = float(len(svc._pending))
+            out["dispatchQueueDepth"] = float(svc.queue_depth())
             q = svc._fetch_q
             out["inflightLaunches"] = float(q.qsize()) if q is not None else 0.0
             out["breakerState"] = {

@@ -1,0 +1,42 @@
+"""Multi-tenant aggregation service: many committees, one device plane.
+
+ROADMAP item 3: "millions of users" means many concurrent aggregation
+instances — distinct messages, rounds, committees — not one big one. This
+package multiplexes N concurrent Handel sessions onto ONE
+`BatchVerifierService` (parallel/batch_verifier.py) and one warm device
+plane: a `SessionManager` owns session lifecycle (spawn → running →
+threshold-reached → expire/evict) behind a bounded concurrent-session cap,
+the verifier's tenant-tagged queue coalesces every session's pending
+candidates into shared 64/128-lane launches under a deficit-round-robin
+fairness policy (`TenantQueue`), and the per-tenant state — dedup verdicts,
+peer penalties, queue bounds — is keyed by session id so evicting a tenant
+drops its footprint wholesale.
+
+Grounded in the ACE runtime direction (PAPERS.md, arxiv 2603.10242):
+sub-second cryptographic finality as a continuously-loaded multiplexed
+service rather than a one-shot run.
+"""
+
+from handel_tpu.service.fairness import TenantQueue
+from handel_tpu.service.session import (
+    AdmissionRefused,
+    Session,
+    SessionManager,
+    STATE_DONE,
+    STATE_EVICTED,
+    STATE_EXPIRED,
+    STATE_RUNNING,
+    STATE_SPAWNED,
+)
+
+__all__ = [
+    "AdmissionRefused",
+    "Session",
+    "SessionManager",
+    "TenantQueue",
+    "STATE_SPAWNED",
+    "STATE_RUNNING",
+    "STATE_DONE",
+    "STATE_EXPIRED",
+    "STATE_EVICTED",
+]

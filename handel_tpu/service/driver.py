@@ -1,0 +1,373 @@
+"""Multi-session service drivers: one process or a small fleet of them.
+
+`MultiSessionCluster` is the in-process form — K concurrent sessions
+(service/session.py) sharing ONE `BatchVerifierService` on one event loop,
+with an optional /metrics endpoint carrying the session-labeled plane.
+`run_service` is the `sim serve` entry: it reads the `[service]` TOML
+section (sim/config.py ServiceParams) and runs the session load either
+in-process (processes = 1) or sharded over M worker node-processes
+(service/worker.py), each worker multiplexing its share of sessions onto
+its own shared verifier — "K sessions over M node-processes".
+
+`HostDevice` adapts host schemes (fake, bn254 reference math) to the
+service's device contract so the WHOLE launch path — tenant queue, DRR
+fairness, cross-session coalescing, fill accounting, breaker — is
+exercised without a chip: one `dispatch_multi` call is one "launch" whose
+lanes may span sessions, messages and registries. Device schemes plug in
+their real `BN254Device` instead (its `dispatch_multi` takes per-lane
+messages, models/bn254_jax.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+from handel_tpu.core.test_harness import FakeScheme
+from handel_tpu.parallel.batch_verifier import BatchVerifierService
+from handel_tpu.service.session import SessionManager
+
+
+class HostDevice:
+    """Device-shaped host verifier behind the shared service.
+
+    `dispatch_multi(items)` — items are (msg, pubkeys, bitset, sig) — runs
+    the scheme constructor's own batch_verify per (message, registry)
+    group, synchronously (the service calls it in an executor thread), and
+    returns the verdicts handle `fetch` hands back. `launch_ms` simulates
+    a fixed device wall per launch (latency-shape experiments); 0 = as
+    fast as the host math goes.
+    """
+
+    def __init__(self, constructor, batch_size: int = 64,
+                 launch_ms: float = 0.0):
+        self.constructor = constructor
+        self.batch_size = batch_size
+        self.launch_ms = launch_ms
+        self.dispatched = 0
+
+    def dispatch_multi(self, items):
+        verdicts: list[bool] = [False] * len(items)
+        groups: dict[tuple, list[int]] = {}
+        for i, (msg, pubkeys, _, _) in enumerate(items):
+            groups.setdefault((msg, id(pubkeys)), []).append(i)
+        for (msg, _), idxs in groups.items():
+            pubkeys = items[idxs[0]][1]
+            reqs = [(items[i][2], items[i][3]) for i in idxs]
+            for i, ok in zip(
+                idxs, self.constructor.batch_verify(msg, pubkeys, reqs)
+            ):
+                verdicts[i] = bool(ok)
+        if self.launch_ms > 0:
+            time.sleep(self.launch_ms / 1000.0)
+        self.dispatched += 1
+        return verdicts
+
+    def fetch(self, handle):
+        return handle
+
+
+class MultiSessionCluster:
+    """K concurrent sessions sharing one BatchVerifierService in-process."""
+
+    def __init__(
+        self,
+        sessions: int,
+        nodes: int,
+        *,
+        threshold: int | None = None,
+        scheme=None,
+        device=None,
+        batch_size: int = 64,
+        max_sessions: int | None = None,
+        session_ttl_s: float = 60.0,
+        quantum: int = 8,
+        max_pending_per_session: int = 4096,
+        max_delay_ms: float = 2.0,
+        spawn_stagger_s: float = 0.0,
+        metrics_port: int | None = None,
+        seed_base: int = 0,
+        config_tweak=None,
+    ):
+        self.k = sessions
+        self.nodes = nodes
+        self.threshold = threshold
+        self.spawn_stagger_s = spawn_stagger_s
+        self.seed_base = seed_base
+        self.config_tweak = config_tweak
+        scheme = scheme or FakeScheme()
+        device = device or HostDevice(
+            scheme.constructor, batch_size=batch_size
+        )
+        self.service = BatchVerifierService(
+            device,
+            max_delay_ms=max_delay_ms,
+            quantum=quantum,
+            max_pending_per_session=max_pending_per_session,
+        )
+        self.manager = SessionManager(
+            service=self.service,
+            scheme=scheme,
+            max_sessions=max_sessions or sessions,
+            session_ttl_s=session_ttl_s,
+        )
+
+        # live telemetry (core/metrics.py): the shared verifier plane plus
+        # the session-labeled service plane — `sim watch --attach` renders
+        # the per-session rows from exactly these families
+        self.metrics = None
+        self.metrics_server = None
+        if metrics_port is not None:
+            from handel_tpu.core.metrics import (
+                MetricsRegistry,
+                MetricsServer,
+            )
+
+            reg = MetricsRegistry()
+            reg.register_values("device_verifier", self.service)
+            reg.register_values("service", self.manager)
+            reg.register_labeled_values(
+                "service",
+                self.manager,
+                label="session",
+                gauges=self.manager.labeled_gauge_keys(),
+            )
+            reg.register_labeled_values(
+                "penalty", self.manager.scorers, label="session"
+            )
+            reg.add_readiness(
+                "sessions_spawned", lambda: self.manager.spawned_ct > 0
+            )
+            self.metrics = reg
+            self.metrics_server = MetricsServer(reg, port=metrics_port).start()
+
+    async def run(self, timeout: float = 120.0) -> dict:
+        """Spawn + start every session, await all terminal states, and
+        return the run summary (the bench/capture record shape)."""
+        t0 = time.perf_counter()
+        for i in range(self.k):
+            s = self.manager.spawn(
+                self.nodes,
+                threshold=self.threshold,
+                seed=self.seed_base + i,
+                config_tweak=self.config_tweak,
+            )
+            self.manager.start(s.sid)
+            if self.spawn_stagger_s > 0:
+                await asyncio.sleep(self.spawn_stagger_s)
+        await self.manager.wait_all(timeout)
+        wall = time.perf_counter() - t0
+        return self.summary(wall)
+
+    def summary(self, wall_s: float) -> dict:
+        mv = self.manager.values()
+        sv = self.service.values()
+        return {
+            "sessions": self.k,
+            "nodes_per_session": self.nodes,
+            "completed": int(mv["sessionsCompleted"]),
+            "expired": int(mv["sessionsExpired"]),
+            "wall_s": round(wall_s, 3),
+            # sustained finality rate: completed aggregation instances
+            # (full threshold aggregates produced) per wall second
+            "aggregates_per_s": round(mv["sessionsCompleted"] / wall_s, 3)
+            if wall_s > 0
+            else 0.0,
+            "session_p50_s": round(mv["sessionCompletionP50S"], 4),
+            "session_p99_s": round(mv["sessionCompletionP99S"], 4),
+            # coalescing evidence: per-launch lane fill + cross-message mix
+            "launch_fill_ratio": round(sv["launchFillRatio"], 4),
+            "verifier_launches": int(sv["verifierLaunches"]),
+            "verifier_candidates": int(sv["verifierCandidates"]),
+            "coalesced_launches": int(sv["coalescedLaunches"]),
+            "dedup_hit_rate": round(sv["dedupHitRate"], 4),
+            "admission_refused": int(sv["admissionRefused"]),
+        }
+
+    def stop(self) -> None:
+        self.manager.stop()
+        self.service.stop()
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
+
+
+def _split(total: int, parts: int) -> list[int]:
+    """total sessions over parts workers, remainder on the first ones."""
+    base, rem = divmod(total, max(1, parts))
+    return [base + (1 if i < rem else 0) for i in range(parts)]
+
+
+async def run_in_process(cfg, *, seed_base: int = 0,
+                         metrics_port: int | None = None,
+                         timeout: float | None = None) -> dict:
+    """One worker's share: build a MultiSessionCluster from the TOML
+    `[service]` section and run it to completion."""
+    p = cfg.service
+    scheme = None
+    if cfg.scheme not in ("", "fake"):
+        from handel_tpu.models.registry import is_device_scheme, new_scheme
+
+        if is_device_scheme(cfg.scheme):
+            raise ValueError(
+                f"sim serve: device scheme {cfg.scheme!r} needs a shared "
+                f"registry across sessions — run it with scheme = 'fake' "
+                f"or a host scheme for now (ROADMAP item 3 follow-up)"
+            )
+        scheme = new_scheme(cfg.scheme)
+
+    def tweak(node_cfg, i):
+        node_cfg.update_period = p.period_ms / 1000.0
+
+    cluster = MultiSessionCluster(
+        p.sessions,
+        p.nodes,
+        threshold=p.threshold or None,
+        scheme=scheme,
+        batch_size=p.batch_size or cfg.batch_size,
+        max_sessions=p.max_sessions or None,
+        session_ttl_s=p.session_ttl_s,
+        quantum=p.quantum,
+        max_pending_per_session=p.max_pending_per_session,
+        spawn_stagger_s=p.spawn_stagger_ms / 1000.0,
+        metrics_port=metrics_port,
+        seed_base=seed_base,
+        config_tweak=tweak,
+    )
+    try:
+        return await cluster.run(timeout or cfg.max_timeout_s)
+    finally:
+        cluster.stop()
+
+
+def merge_summaries(parts: list[dict]) -> dict:
+    """Fleet summary from per-worker summaries: counts sum, rates sum
+    (workers run concurrently), latency percentiles take the worst-case
+    worker (conservative — exact merge would need the raw samples),
+    fill/dedup weight by launches."""
+    out = {
+        "sessions": sum(p["sessions"] for p in parts),
+        "nodes_per_session": parts[0]["nodes_per_session"] if parts else 0,
+        "completed": sum(p["completed"] for p in parts),
+        "expired": sum(p["expired"] for p in parts),
+        "wall_s": max((p["wall_s"] for p in parts), default=0.0),
+        "aggregates_per_s": round(
+            sum(p["aggregates_per_s"] for p in parts), 3
+        ),
+        "session_p50_s": max((p["session_p50_s"] for p in parts), default=0.0),
+        "session_p99_s": max((p["session_p99_s"] for p in parts), default=0.0),
+        "verifier_launches": sum(p["verifier_launches"] for p in parts),
+        "verifier_candidates": sum(p["verifier_candidates"] for p in parts),
+        "coalesced_launches": sum(p["coalesced_launches"] for p in parts),
+        "admission_refused": sum(p["admission_refused"] for p in parts),
+        "workers": len(parts),
+    }
+    launches = out["verifier_launches"]
+    out["launch_fill_ratio"] = (
+        round(
+            sum(p["launch_fill_ratio"] * p["verifier_launches"]
+                for p in parts) / launches,
+            4,
+        )
+        if launches
+        else 0.0
+    )
+    hits = sum(
+        p["dedup_hit_rate"] * p["verifier_candidates"] for p in parts
+    )
+    out["dedup_hit_rate"] = (
+        round(hits / out["verifier_candidates"], 4)
+        if out["verifier_candidates"]
+        else 0.0
+    )
+    return out
+
+
+async def run_service(cfg, workdir: str, config_path: str = "") -> dict:
+    """The `sim serve` orchestrator: K sessions over M node-processes.
+
+    processes = 1 runs in this process. Otherwise M workers
+    (service/worker.py) each run their share of sessions against their own
+    shared verifier; per-worker summaries merge into one record, written to
+    `<workdir>/service_summary.json` either way.
+    """
+    from handel_tpu.sim.config import dump_config
+
+    p = cfg.service
+    if p.sessions <= 0:
+        raise ValueError("no [service] section (service.sessions must be > 0)")
+    os.makedirs(workdir, exist_ok=True)
+    if not config_path:
+        config_path = os.path.join(workdir, "serve.toml")
+        with open(config_path, "w") as f:
+            f.write(dump_config(cfg))
+
+    metrics_ports: list[int] = []
+    if cfg.metrics:
+        from handel_tpu.sim.platform import free_ports, write_metrics_ports
+
+        metrics_ports = free_ports(max(1, p.processes))
+        write_metrics_ports(
+            workdir, 0, dict(enumerate(metrics_ports))
+        )
+
+    if p.processes <= 1:
+        summary = await run_in_process(
+            cfg,
+            metrics_port=metrics_ports[0] if metrics_ports else None,
+        )
+        summary["workers"] = 1
+    else:
+        shares = _split(p.sessions, p.processes)
+        procs = []
+        for i, share in enumerate(shares):
+            if share <= 0:
+                continue
+            cmd = [
+                sys.executable,
+                "-m",
+                "handel_tpu.service.worker",
+                "--config",
+                config_path,
+                "--index",
+                str(i),
+                "--sessions",
+                str(share),
+            ]
+            if metrics_ports:
+                cmd += ["--metrics-port", str(metrics_ports[i])]
+            procs.append(
+                await asyncio.create_subprocess_exec(
+                    *cmd,
+                    stdout=asyncio.subprocess.PIPE,
+                    stderr=asyncio.subprocess.PIPE,
+                )
+            )
+        outs = await asyncio.gather(*(pr.communicate() for pr in procs))
+        parts: list[dict] = []
+        for pr, (out, err) in zip(procs, outs):
+            if pr.returncode != 0:
+                sys.stderr.write(err.decode(errors="replace"))
+                raise RuntimeError(
+                    f"service worker failed (rc={pr.returncode})"
+                )
+            for line in out.decode().splitlines():
+                if line.startswith("SERVICE_RESULT "):
+                    parts.append(json.loads(line[len("SERVICE_RESULT "):]))
+        if len(parts) != len(procs):
+            raise RuntimeError(
+                f"{len(parts)}/{len(procs)} workers reported a summary"
+            )
+        summary = merge_summaries(parts)
+
+    summary["scheme"] = cfg.scheme
+    summary["ok"] = (
+        summary["expired"] == 0
+        and summary["completed"] == summary["sessions"]
+    )
+    with open(os.path.join(workdir, "service_summary.json"), "w") as f:
+        json.dump(summary, f, indent=1)
+        f.write("\n")
+    return summary

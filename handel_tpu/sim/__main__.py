@@ -6,12 +6,14 @@ in order on the chosen platform, abort a run after MaxTimeout.
 Usage: python -m handel_tpu.sim --config sim.toml --workdir out/
        python -m handel_tpu.sim trace <trace-dir>   (analyze a traced run)
        python -m handel_tpu.sim watch sim.toml      (live /metrics dashboard)
+       python -m handel_tpu.sim serve sim.toml      (multi-session service)
 """
 
 from __future__ import annotations
 
 import argparse
 import asyncio
+import json
 import sys
 
 from handel_tpu.sim.config import load_config
@@ -31,6 +33,20 @@ def main() -> int:
         from handel_tpu.sim.watch_cli import main as watch_main
 
         return watch_main(sys.argv[2:])
+    if len(sys.argv) > 1 and sys.argv[1] == "serve":
+        # multi-tenant service subcommand (handel_tpu/service/driver.py):
+        # run the [service] TOML section's K concurrent sessions over M
+        # worker processes, one shared BatchVerifierService per process
+        sap = argparse.ArgumentParser(prog="python -m handel_tpu.sim serve")
+        sap.add_argument("config")
+        sap.add_argument("--workdir", default="serve_out")
+        sargs = sap.parse_args(sys.argv[2:])
+        from handel_tpu.service.driver import run_service
+
+        cfg = load_config(sargs.config)
+        summary = asyncio.run(run_service(cfg, sargs.workdir, sargs.config))
+        print(json.dumps(summary))
+        return 0 if summary["ok"] else 1
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", required=True)
     ap.add_argument("--workdir", default="sim_out")

@@ -117,6 +117,30 @@ class RunConfig:
 
 
 @dataclass
+class ServiceParams:
+    """`[service]` section: the multi-tenant aggregation service
+    (handel_tpu/service/). sessions = 0 keeps service mode off; `sim
+    serve` requires it > 0. Each of `sessions` concurrent aggregation
+    instances runs `nodes` logical Handel nodes over its own committee,
+    all multiplexed onto one shared BatchVerifierService per process."""
+
+    sessions: int = 0
+    nodes: int = 16
+    threshold: int = 0  # 0 -> default percentage of `nodes`
+    processes: int = 1  # worker node-processes the sessions shard over
+    max_sessions: int = 0  # live-session admission cap; 0 -> `sessions`
+    session_ttl_s: float = 60.0  # running session expiry deadline
+    quantum: int = 8  # DRR lane credits per tenant ring visit
+    max_pending_per_session: int = 4096  # per-tenant verifier queue bound
+    batch_size: int = 0  # shared-launch lanes; 0 -> global batch_size
+    spawn_stagger_ms: float = 0.0  # delay between session spawns
+    period_ms: float = 10.0  # gossip period of the session nodes
+
+    def enabled(self) -> bool:
+        return self.sessions > 0
+
+
+@dataclass
 class HostSpec:
     """One host of the remote platform's fleet (sim/remote.py; the analog
     of an aws.go instance entry)."""
@@ -167,6 +191,8 @@ class SimConfig:
     # -- fault injection (network/chaos.py): applied to every node's
     # transport when any rate is nonzero; seeds derive per node ------------
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
+    # -- multi-tenant service (handel_tpu/service/; `sim serve`) -----------
+    service: ServiceParams = field(default_factory=ServiceParams)
     # -- remote platform (sim/remote.py; aws.go analog) --------------------
     hosts: list[HostSpec] = field(default_factory=list)
     master_ip: str = "127.0.0.1"  # address remote nodes dial the master at
@@ -207,6 +233,20 @@ def load_config(path: str) -> SimConfig:
         delay_jitter_ms=float(ch.get("delay_jitter_ms", 0.0)),
         seed=int(ch.get("seed", 0)),
     ).validate()
+    sv = raw.get("service", {})
+    cfg.service = ServiceParams(
+        sessions=int(sv.get("sessions", 0)),
+        nodes=int(sv.get("nodes", 16)),
+        threshold=int(sv.get("threshold", 0)),
+        processes=int(sv.get("processes", 1)),
+        max_sessions=int(sv.get("max_sessions", 0)),
+        session_ttl_s=float(sv.get("session_ttl_s", 60.0)),
+        quantum=int(sv.get("quantum", 8)),
+        max_pending_per_session=int(sv.get("max_pending_per_session", 4096)),
+        batch_size=int(sv.get("batch_size", 0)),
+        spawn_stagger_ms=float(sv.get("spawn_stagger_ms", 0.0)),
+        period_ms=float(sv.get("period_ms", 10.0)),
+    )
     for h in raw.get("hosts", []):
         cfg.hosts.append(
             HostSpec(
@@ -280,6 +320,22 @@ def dump_config(cfg: SimConfig) -> str:
             f"delay_ms = {cfg.chaos.delay_ms}",
             f"delay_jitter_ms = {cfg.chaos.delay_jitter_ms}",
             f"seed = {cfg.chaos.seed}",
+        ]
+    if cfg.service.enabled():
+        lines += [
+            "",
+            "[service]",
+            f"sessions = {cfg.service.sessions}",
+            f"nodes = {cfg.service.nodes}",
+            f"threshold = {cfg.service.threshold}",
+            f"processes = {cfg.service.processes}",
+            f"max_sessions = {cfg.service.max_sessions}",
+            f"session_ttl_s = {cfg.service.session_ttl_s}",
+            f"quantum = {cfg.service.quantum}",
+            f"max_pending_per_session = {cfg.service.max_pending_per_session}",
+            f"batch_size = {cfg.service.batch_size}",
+            f"spawn_stagger_ms = {cfg.service.spawn_stagger_ms}",
+            f"period_ms = {cfg.service.period_ms}",
         ]
     for h in cfg.hosts:
         lines += [

@@ -121,7 +121,36 @@ def aggregate(scrapes: list[dict]) -> dict:
         fams, "handel_device_verifier_breaker_state"
     )] + [v for _, v in _samples(fams, "handel_device_breaker_state")]
 
+    # multi-tenant service plane (handel_tpu/service/): per-session rows
+    # keyed by the `session` label dimension, plus the manager aggregates
+    sessions: dict[str, dict] = {}
+    for field, name in (
+        ("state", "handel_service_state"),
+        ("pending", "handel_service_pending"),
+        ("nodes_done", "handel_service_nodes_done"),
+        ("nodes", "handel_service_nodes"),
+        ("best", "handel_service_best_cardinality"),
+        ("threshold", "handel_service_threshold"),
+        ("queue", "handel_service_queue_depth"),
+    ):
+        for labels, v in _samples(fams, name):
+            sid = labels.get("session")
+            if sid:
+                sessions.setdefault(sid, {})[field] = v
+
+    def first(name):
+        s = _samples(fams, name)
+        return s[0][1] if s else None
+
     return {
+        "sessions": sessions,
+        "service_live": total("handel_service_sessions_live"),
+        "service_completed": total("handel_service_sessions_completed"),
+        "service_expired": total("handel_service_sessions_expired"),
+        "service_evicted": total("handel_service_sessions_evicted"),
+        "service_p50": first("handel_service_session_completion_p50_s"),
+        "service_p99": first("handel_service_session_completion_p99_s"),
+        "launch_fill": mean("handel_device_verifier_launch_fill_ratio"),
         "nodes": len(per_node_levels),
         "levels": per_node_levels,
         "best_min": min(best) if best else None,
@@ -168,6 +197,46 @@ def _bar(filled: int, total: int, width: int = 24) -> str:
     return "#" * n + "." * (width - n)
 
 
+#: handel_service_state code -> display name (service/session.py STATE_CODE)
+_STATE_NAMES = {0: "spawned", 1: "running", 2: "done", 3: "expired",
+                4: "evicted"}
+
+TOP_K_SESSIONS = 8
+
+
+def render_sessions(model: dict) -> list[str]:
+    """Per-session row block: top-K sessions by pending work, each with
+    its state and completion wave (nodes at threshold / committee size)."""
+    sessions = model.get("sessions") or {}
+    if not sessions and model.get("service_live") is None:
+        return []
+    lines = [
+        f"sessions  live {_num(model.get('service_live'))}  "
+        f"done {_num(model.get('service_completed'))}  "
+        f"expired {_num(model.get('service_expired'))}  "
+        f"evicted {_num(model.get('service_evicted'))}   "
+        f"completion p50 {_ms(model.get('service_p50'))}  "
+        f"p99 {_ms(model.get('service_p99'))}"
+    ]
+    top = sorted(
+        sessions.items(),
+        key=lambda kv: kv[1].get("pending", 0.0),
+        reverse=True,
+    )[:TOP_K_SESSIONS]
+    for sid, row in top:
+        state = _STATE_NAMES.get(int(row.get("state", 0)), "?")
+        nodes = int(row.get("nodes", 0))
+        done = int(row.get("nodes_done", 0))
+        lines.append(
+            f"  {sid:>8} {state:<8} pending {int(row.get('pending', 0)):>6}"
+            f"  wave {_bar(done, nodes, 16)} {done}/{nodes}"
+            f"  best {int(row.get('best', 0))}/{int(row.get('threshold', 0))}"
+        )
+    if len(sessions) > len(top):
+        lines.append(f"  ... {len(sessions) - len(top)} more sessions")
+    return lines
+
+
 def render(model: dict, endpoints: list[str], up: int, tick: int) -> str:
     """One dashboard frame as plain text (the caller adds ANSI)."""
     lines = [
@@ -196,8 +265,12 @@ def render(model: dict, endpoints: list[str], up: int, tick: int) -> str:
                 f"  level-complete    p50 {_ms(model['wave_p50'])}  "
                 f"p99 {_ms(model['wave_p99'])}"
             )
-    else:
+    elif not model.get("sessions"):
         lines.append("aggregation wave: no sigs plane scraped yet")
+    srows = render_sessions(model)
+    if srows:
+        lines.append("")
+        lines.extend(srows)
     lines.append("")
     lines.append(
         f"verify   p50 {_ms(model['verify_p50'])}  "
@@ -207,9 +280,11 @@ def render(model: dict, endpoints: list[str], up: int, tick: int) -> str:
     )
     dd = model["dedup_rate"]
     occ = model["occupancy"]
+    fill = model.get("launch_fill")
     lines.append(
         f"verifier launches {_num(model['verifier_launches'])}  "
         f"occupancy {('--' if occ is None else f'{occ:.2f}')}  "
+        f"fill {('--' if fill is None else f'{fill:.2f}')}  "
         f"dedup hit rate {('--' if dd is None else f'{dd:.1%}')}"
     )
     if model["breaker_total"]:

@@ -42,6 +42,12 @@ SIDE_METRICS = {
     "host_dispatch_ms": "lower",
     "no_transfer_steady_state": "higher",
     "dedup_hit_rate": "higher",
+    # multi-tenant service plane (bench.py service_bench / sim serve):
+    # sustained session completions per second, tail completion latency
+    # under concurrent load, and coalesced launch lane fill
+    "aggregates_per_s": "higher",
+    "session_p99_s": "lower",
+    "launch_fill_ratio": "higher",
 }
 
 

@@ -132,3 +132,52 @@ def test_prefix_table_matches_host(setup):
             assert (xs[i], ys[i]) == acc, f"prefix slot {i}"
         if i < N:
             acc = nat.g2_add(acc, nat.g2_mul(bn.G2_GEN, sks[i]))
+
+
+def test_dispatch_multi_per_lane_messages(setup):
+    """One launch whose lanes carry DIFFERENT messages (the multi-tenant
+    service's cross-session coalescing, dispatch_multi): every lane's
+    pairing check runs against ITS message's H(m) — a valid aggregate
+    claimed under the wrong message must fail its lane."""
+    device, sks, h = setup
+    msg2 = b"second tenant message"
+    h2 = hash_to_g1(msg2)
+    good_m1 = _request(sks, h, range(0, 3))
+    good_m2 = _request(sks, h2, range(3, 6))
+    # a third lane back on msg1 (messages interleave across lanes)
+    good_m1b = _request(sks, h, [6, 7])
+    # valid aggregate for MSG placed in a msg2 lane: must fail
+    wrong_msg = _request(sks, h, [1, 2])
+    verdicts = device.fetch(
+        device.dispatch_multi(
+            [
+                (MSG, None, *good_m1),
+                (msg2, None, *good_m2),
+                (MSG, None, *good_m1b),
+                (msg2, None, *wrong_msg),
+            ]
+        )
+    )
+    assert verdicts == [True, True, True, False]
+    assert device.multi_msg_launches == 1
+    # uniform-message batches keep the ordinary dispatch path (no extra
+    # kernel variant, cached (L, 1) h)
+    before = device.multi_msg_launches
+    verdicts = device.fetch(
+        device.dispatch_multi(
+            [(MSG, None, *good_m1), (MSG, None, *good_m1b)]
+        )
+    )
+    assert verdicts == [True, True]
+    assert device.multi_msg_launches == before
+
+
+def test_warmup_multi_msg_compiles_variant(setup):
+    """warmup(multi_msg=True) pre-compiles the per-lane-h range variant so
+    a service's first coalesced launch never stalls on XLA."""
+    device, sks, h = setup
+    n_before = device.multi_msg_launches
+    launches = device.warmup(multi_msg=True)
+    assert launches >= 4
+    assert device.multi_msg_launches == n_before + 1
+    assert device.host_pack_launches == 0  # warmup resets host counters
