@@ -359,6 +359,110 @@ def _service_metrics() -> dict:
         return {}
 
 
+def fleet_bench(
+    devices: int = 8,
+    requests_n: int = 160,
+    batch_size: int = 4,
+    launch_ms: float = 8.0,
+    timeout_s: float = 60.0,
+) -> dict:
+    """Fleet-of-chips verify plane: K-lane DevicePlane throughput vs an
+    identical 1-lane baseline under a flood of distinct aggregates. The
+    launch wall is simulated by HostDevice.launch_ms so what's measured is
+    the plane scheduler (least-loaded pick, per-lane queues overlapping
+    dispatch), not crypto — the per-chip crypto figure is the headline
+    above. Reports launches/s for the fleet, the speedup over the 1-lane
+    run (the no-idle-while-queued claim: with launch wall dominating, K
+    lanes must approach Kx), the fleet's per-launch fill, and the
+    scheduler's idle-violation audit counter (a pick that left a queued
+    batch while an idle lane existed — must stay 0).
+    """
+    import asyncio
+    import concurrent.futures
+
+    from handel_tpu.core.bitset import BitSet
+    from handel_tpu.core.test_harness import FakeScheme
+    from handel_tpu.models.fake import FakePublic, FakeSignature
+    from handel_tpu.parallel.batch_verifier import BatchVerifierService
+    from handel_tpu.parallel.plane import host_plane
+
+    pks = [FakePublic(True) for _ in range(16)]
+
+    def reqs():
+        out = []
+        for i in range(requests_n):
+            bs = BitSet(16)
+            bs.set(i % 16, True)
+            # distinct message per request: no dedup/coalescing — every
+            # request is a real candidate the plane must launch
+            out.append((i.to_bytes(4, "big"), (bs, FakeSignature(True))))
+        return out
+
+    async def run(k: int) -> tuple[float, dict]:
+        # a 1-core default executor (5 threads) would cap lane overlap
+        # below the plane width — give the loop enough threads that every
+        # lane's dispatch and fetch can be in flight at once
+        loop = asyncio.get_running_loop()
+        loop.set_default_executor(
+            concurrent.futures.ThreadPoolExecutor(max_workers=2 * k + 4)
+        )
+        plane = host_plane(
+            FakeScheme().constructor,
+            k,
+            batch_size=batch_size,
+            launch_ms=launch_ms,
+        )
+        svc = BatchVerifierService(plane, max_delay_ms=0.2)
+        try:
+            t0 = time.perf_counter()
+            verdicts = await asyncio.wait_for(
+                asyncio.gather(
+                    *(
+                        svc.verify(msg, pks, [r], session=f"s{i % 8}")
+                        for i, (msg, r) in enumerate(reqs())
+                    )
+                ),
+                timeout_s,
+            )
+            wall = time.perf_counter() - t0
+            if not all(v == [True] for v in verdicts):
+                raise RuntimeError("fleet bench verdict mismatch")
+            vals = svc.values()
+            vals["_wall_s"] = wall
+            return wall, vals
+        finally:
+            svc.stop()
+
+    base_wall, base_vals = asyncio.run(run(1))
+    fleet_wall, fleet_vals = asyncio.run(run(devices))
+    base_rate = base_vals["verifierLaunches"] / base_wall
+    fleet_rate = fleet_vals["verifierLaunches"] / fleet_wall
+    return {
+        "launches_per_s": round(fleet_rate, 2),
+        "fleet_speedup_x": round(fleet_rate / base_rate, 2)
+        if base_rate > 0
+        else None,
+        "fleet_fill_ratio": round(fleet_vals["launchFillRatio"], 4),
+        "fleet_idle_violations": int(fleet_vals["schedIdleViolations"]),
+        "fleet_devices": int(fleet_vals["devicesTotal"]),
+    }
+
+
+def _fleet_metrics() -> dict:
+    """fleet_bench behind the degrade-don't-die contract (+ a shape
+    override for tests: HANDEL_TPU_BENCH_FLEET_SHAPE =
+    'devices,requests,batch')."""
+    shape = os.environ.get("HANDEL_TPU_BENCH_FLEET_SHAPE")
+    try:
+        if shape:
+            devices, requests_n, batch = (int(x) for x in shape.split(","))
+            return fleet_bench(devices, requests_n, batch)
+        return fleet_bench()
+    except Exception as e:
+        print(f"bench: fleet bench failed: {e}", file=sys.stderr)
+        return {}
+
+
 def _host_metrics() -> dict:
     """host_pipeline_bench behind the bench's degrade-don't-die contract
     (+ a shape override for tests: HANDEL_TPU_BENCH_HOST_SHAPE =
@@ -721,6 +825,8 @@ def _measure() -> None:
         # multi-tenant service plane: sustained aggregates/s + p99 session
         # completion + coalesced launch fill (protocol-layer, no kernels)
         line.update(_service_metrics())
+        # fleet plane: K-lane DevicePlane scheduler throughput vs 1 lane
+        line.update(_fleet_metrics())
 
         def persist(extra_line: dict) -> None:
             # provenance so a later tunnel outage can't erase the capture
@@ -785,6 +891,7 @@ def _measure() -> None:
         }
         line.update(_host_metrics())
         line.update(_service_metrics())
+        line.update(_fleet_metrics())
         _emit(line)
 
 

@@ -219,7 +219,9 @@ class _LabeledReporterCollector:
     """Bridges a `labeled_values()` reporter — {label value: {key: val}} —
     into families carrying a label DIMENSION (one family per key, one
     sample per label value). The multi-tenant service plane uses it with
-    label="session": `handel_service_pending{session="s3"} 17`."""
+    label="session" (`handel_service_pending{session="s3"} 17`), the
+    device plane with label="device"
+    (`handel_device_verifier_launches{device="3"} 12`)."""
 
     def __init__(self, plane, reporter, label, labels, gauges):
         self.plane = plane
@@ -231,7 +233,12 @@ class _LabeledReporterCollector:
     def _gauge_set(self):
         if self._explicit is not None:
             return self._explicit
-        gk = getattr(self.reporter, "gauge_keys", None)
+        # a reporter may expose different gauge sets for its aggregate
+        # values() and its per-label rows (parallel/plane.py DevicePlane
+        # does): the labeled declaration wins here when present
+        gk = getattr(self.reporter, "labeled_gauge_keys", None)
+        if not callable(gk):
+            gk = getattr(self.reporter, "gauge_keys", None)
         return set(gk()) if callable(gk) else set()
 
     def collect(self) -> Iterable[Family]:

@@ -126,11 +126,23 @@ class BN254Device:
         batch_size: int = 16,
         curves: BN254Curves | None = None,
         mesh_devices: int = 1,
+        jax_device=None,
     ):
         self.curves = curves or self.Curves()
         self.pairing = self.Pairing(self.curves)
         self.batch_size = batch_size
         self.n = len(registry_pubkeys)
+        # fleet pinning (parallel/plane.py): when `jax_device` is given,
+        # every explicit put — registry commit, staging handoff, cached
+        # H(m) — lands COMMITTED to that chip, so jit executes this
+        # engine's launches there and K engines fill K chips concurrently.
+        # None keeps the historical uncommitted-default placement.
+        self.jax_device = jax_device
+        self._dput = (
+            partial(jax.device_put, device=jax_device)
+            if jax_device is not None
+            else jax.device_put
+        )
         T = self.curves.T
         pts = [pk.point for pk in registry_pubkeys]
         if any(p is None for p in pts):
@@ -141,8 +153,8 @@ class BN254Device:
         # steady-state launches perform no implicit host→device transfer
         # of registry/prefix data (pinned by tests/test_device_residency.py
         # under jax.transfer_guard)
-        self._reg_x = jax.device_put(T.f2_pack([p[0] for p in pts]))
-        self._reg_y = jax.device_put(T.f2_pack([p[1] for p in pts]))
+        self._reg_x = self._dput(T.f2_pack([p[0] for p in pts]))
+        self._reg_y = self._dput(T.f2_pack([p[1] for p in pts]))
         # multi-chip plane (SURVEY.md §5.7): registry shards over the mesh
         # for the masked G2 segment-sum, candidate lanes shard for the
         # pairing check. Same host entry points — `_dispatch_one` routes to
@@ -157,6 +169,7 @@ class BN254Device:
         self._sharded_sum = self._sharded_check = None
         if mesh_devices > 1:
             from handel_tpu.parallel.sharding import (
+                commit_registry_sharded,
                 make_mesh,
                 sharded_masked_sum_g2,
                 sharded_pairing_check,
@@ -168,6 +181,15 @@ class BN254Device:
             )
             self._sharded_check = sharded_pairing_check(
                 self.pairing, self.mesh, batch_size
+            )
+            # the mesh counterpart of the single-chip resident registry:
+            # pad the coordinate arrays to the device multiple and commit
+            # one shard per chip ONCE, here — before this, every dense
+            # sharded launch handed the full replicated arrays to
+            # `_sharded_sum` and paid a re-shard (all-to-all of the whole
+            # registry) per launch
+            self._reg_sharded = commit_registry_sharded(
+                self.mesh, self._reg_x, self._reg_y, self.n
             )
             self._affine_kernel = jax.jit(self.curves.g2.to_affine)
             self._neg_kernel = jax.jit(self.curves.F.neg)
@@ -424,8 +446,8 @@ class BN254Device:
         if cached is None:
             h = self._hash_to_g1(msg)
             cached = (
-                self.curves.F.pack([h[0]]),
-                self.curves.F.pack([h[1]]),
+                self._dput(self.curves.F.pack([h[0]])),
+                self._dput(self.curves.F.pack([h[1]])),
             )
             self._h_cache[msg] = cached
         return cached
@@ -844,9 +866,10 @@ class BN254Device:
         steady-state launch performs — everything else (registry, prefix
         table, cached H(m)) is device-resident — which is what lets the
         transfer-guard test allowlist staging while banning implicit
-        transfers outright. Returns the per-kind device-argument tuple.
+        transfers outright. Returns the per-kind device-argument tuple
+        (committed to this engine's pinned chip when one was given).
         """
-        dp = jax.device_put
+        dp = self._dput
         if plan.kind == "range":
             return (
                 dp(plan.lo),
@@ -899,13 +922,11 @@ class BN254Device:
                 .view(np.bool_)
                 .T.copy()
             )
-            agg = self._sharded_sum(
-                self._reg_x[0],
-                self._reg_x[1],
-                self._reg_y[0],
-                self._reg_y[1],
-                jnp.asarray(mask),
-            )
+            # registry operands are the PRE-PADDED mesh-resident shards
+            # committed at construction (one per chip); only the per-launch
+            # mask crosses the host boundary here
+            (rx0, rx1), (ry0, ry1) = self._reg_sharded
+            agg = self._sharded_sum(rx0, rx1, ry0, ry1, jnp.asarray(mask))
             return self._sharded_tail(agg, sig_x, sig_y, h_x, h_y, valid)
         return self._kernel(
             self._reg_x,
@@ -975,7 +996,7 @@ class BN254Device:
             hy = np.concatenate(
                 [hy, np.repeat(hy[:, -1:], C - len(msgs), axis=1)], axis=1
             )
-        return jax.device_put(hx), jax.device_put(hy)
+        return self._dput(hx), self._dput(hy)
 
     def dispatch_multi(self, items):
         """Enqueue one launch whose lanes may carry DIFFERENT messages —

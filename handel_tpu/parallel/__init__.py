@@ -13,10 +13,13 @@ from handel_tpu.parallel.sharding import (
     sharded_masked_sum_g2,
 )
 from handel_tpu.parallel.batch_verifier import BatchVerifierService
+from handel_tpu.parallel.plane import DeviceLane, DevicePlane
 
 __all__ = [
     "make_mesh",
     "sharded_pairing_check",
     "sharded_masked_sum_g2",
     "BatchVerifierService",
+    "DeviceLane",
+    "DevicePlane",
 ]

@@ -1,4 +1,4 @@
-"""Shared batch-verifier service: many logical nodes, one device launch.
+"""Shared batch-verifier service: many logical nodes, one device plane.
 
 SURVEY.md §2.4 ("Intra-instance concurrency" row): the reference packs many
 Handel instances into one process (simul/node/main.go:61-78) but each verifies
@@ -20,6 +20,16 @@ legacy single-message devices fall back to one launch per distinct
 message. Dedup verdicts are keyed per session: the same aggregate content
 seen by two different sessions is two different facts (different
 committees/rounds), never cross-deduped.
+
+Fleet-of-chips extension (ROADMAP item 2, parallel/plane.py): the service
+accepts either one device engine or a `DevicePlane` of K. Each plane lane
+owns its dispatch slot, in-flight window, and circuit breaker; the
+collector reserves the least-loaded free lane BEFORE draining the tenant
+queue, then per-lane dispatcher/fetcher tasks run the two pipeline stages
+concurrently across chips — fetch latency on one chip never idles the
+others, and a single open breaker degrades the plane to K-1 lanes instead
+of failing the run. A bare engine is wrapped in a plane of one, so the
+single-chip path is the same code with K=1.
 """
 
 from __future__ import annotations
@@ -32,11 +42,11 @@ from handel_tpu.core.bitset import BitSet
 from handel_tpu.core.logging import DEFAULT_LOGGER, Logger
 from handel_tpu.core.store import VerifiedAggCache
 from handel_tpu.core.trace import SERVICE_TID, trace_now
-from handel_tpu.models.bn254_jax import BN254Device
+from handel_tpu.parallel.plane import BREAKER_CODE, DeviceLane, DevicePlane
 from handel_tpu.service.fairness import TenantQueue
 from handel_tpu.utils.breaker import CircuitBreaker
 
-__all__ = ["BatchVerifierService", "CircuitBreaker"]
+__all__ = ["BatchVerifierService", "CircuitBreaker", "DevicePlane"]
 
 
 # the host fallback contract: (msg, [(global bitset, signature)]) -> verdicts,
@@ -67,11 +77,16 @@ class BatchVerifierService:
     instead of each taking their own. The session id in the key is the
     tenant-isolation boundary: identical bytes in two sessions stay two
     verifications.
+
+    `device` may be a single engine (wrapped in a plane of one; the
+    `breaker` argument becomes that lane's breaker) or a `DevicePlane`
+    whose lanes already own their breakers. `self.device`/`self.breaker`
+    always alias lane 0 — the single-chip monitoring/back-compat surface.
     """
 
     def __init__(
         self,
-        device: BN254Device,
+        device,
         max_delay_ms: float = 2.0,
         max_inflight: int = 2,
         dedup_cache: VerifiedAggCache | None = None,
@@ -85,7 +100,14 @@ class BatchVerifierService:
         quantum: int = 8,
         max_pending_per_session: int = 4096,
     ):
-        self.device = device
+        if isinstance(device, DevicePlane):
+            self.plane = device
+        else:
+            self.plane = DevicePlane(
+                [device], breakers=[breaker or CircuitBreaker()]
+            )
+        self.device = self.plane.lanes[0].engine
+        self.breaker = self.plane.lanes[0].breaker
         # flight recorder (core/trace.py): dispatch-pack (host prep) and
         # device-verify (launch wall) spans + breaker/failover instants,
         # recorded on the service's own trace lane (SERVICE_TID)
@@ -94,13 +116,13 @@ class BatchVerifierService:
             recorder.name_thread(SERVICE_TID, "batch-verifier")
         self.max_delay = max_delay_ms / 1000.0
         self.max_inflight = max(1, max_inflight)
-        # -- resilience plane: breaker + host failover ---------------------
+        # -- resilience plane: per-lane breakers + host failover ------------
         # transient device errors retry with capped exponential backoff;
-        # persistent ones open the breaker and route batches to `fallback`
-        # (host reference verifier) so a dead accelerator degrades
-        # throughput instead of stalling every node
+        # persistent ones open THAT lane's breaker so the scheduler routes
+        # around the chip. Only when every lane's breaker is open do batches
+        # go to `fallback` (host reference verifier) — a dead accelerator
+        # degrades throughput instead of stalling every node.
         self.fallback = fallback
-        self.breaker = breaker or CircuitBreaker()
         self.retry_limit = max(0, retry_limit)
         self.backoff_base_s = backoff_base_s
         self.backoff_cap_s = backoff_cap_s
@@ -118,14 +140,14 @@ class BatchVerifierService:
         )
         self._kick = asyncio.Event()
         self._task: asyncio.Task | None = None
-        self._fetch_task: asyncio.Task | None = None
-        self._fetch_q: asyncio.Queue | None = None
-        # batches held by a pipeline stage OUTSIDE the queue/_fetch_q — the
-        # collector's dispatch-in-progress and the fetcher's fetch-in-progress
-        # — so stop() can fail their waiters too (a cancelled stage would
-        # otherwise strand them awaiting forever; ADVICE r5 #1)
-        self._collecting: list | None = None
-        self._fetching: list | None = None
+        self._lane_tasks: list[asyncio.Task] = []
+        self._free: asyncio.Event | None = None
+        # the batch held by the collector between queue.take() and lane
+        # hand-off — outside the queue and every lane structure — so stop()
+        # can fail its waiters too (ADVICE r5 #1). Batches held by lane
+        # stages are tracked on the lanes (dispatching/fetching); the
+        # `_collecting`/`_fetching` properties below present the union.
+        self._collector_held: list | None = None
         # verified-aggregate dedup (shared across every node on this
         # service, keyed per session)
         self.cache = dedup_cache or VerifiedAggCache(capacity=8192)
@@ -148,51 +170,102 @@ class BatchVerifierService:
 
     def start(self) -> None:
         loop = asyncio.get_running_loop()
-        # bounded handoff queue between the dispatch and fetch stages:
-        # dispatch of launch N+1 proceeds while N's verdicts are still in
-        # flight, so the per-dispatch round trip (~66 ms through this
-        # environment's tunnel, results/verify_profile.json) amortizes
-        # across concurrent launches instead of serializing with the chip
-        # compute. maxsize bounds device-side queue depth.
-        self._fetch_q = asyncio.Queue(maxsize=self.max_inflight)
+        self._free = asyncio.Event()
+        self._lane_tasks = []
+        for lane in self.plane.lanes:
+            # hand-off cell (collector -> lane dispatcher; capacity 1: a
+            # lane is reserved before the collector drains the queue, so it
+            # never carries more than one undelivered group) and the
+            # bounded dispatch->fetch window: dispatch of launch N+1
+            # proceeds while N's verdicts are still in flight, so the
+            # per-dispatch round trip (~66 ms through this environment's
+            # tunnel, results/verify_profile.json) amortizes across
+            # concurrent launches instead of serializing with the chip
+            # compute. maxsize bounds device-side queue depth PER LANE.
+            lane.q = asyncio.Queue(maxsize=1)
+            lane.fetch_q = asyncio.Queue(maxsize=self.max_inflight)
+            self._lane_tasks.append(
+                loop.create_task(self._lane_dispatcher(lane))
+            )
+            self._lane_tasks.append(loop.create_task(self._lane_fetcher(lane)))
         self._task = loop.create_task(self._collector())
-        self._fetch_task = loop.create_task(self._fetcher())
 
     def stop(self) -> None:
-        """Cancel both pipeline stages and FAIL any unanswered waiters —
+        """Cancel every pipeline stage and FAIL any unanswered waiters —
         dropping them would leave callers awaiting forever. That includes
-        the batch each stage holds OUTSIDE the queue/_fetch_q while it
-        works (dispatch or fetch in flight): cancelling the stage strands
-        those futures unless they are failed here. Resetting _task lets a
-        later verify() restart the service."""
+        the batch each stage holds OUTSIDE the queues while it works
+        (collector hand-off, dispatch or fetch in flight on any lane):
+        cancelling the stage strands those futures unless they are failed
+        here. Resetting _task lets a later verify() restart the service."""
         if self._task:
             self._task.cancel()
             self._task = None
-        if self._fetch_task:
-            self._fetch_task.cancel()
-            self._fetch_task = None
+        for t in self._lane_tasks:
+            t.cancel()
+        self._lane_tasks = []
         err = RuntimeError("batch verifier stopped")
-        if self._fetch_q is not None:
-            while True:
-                try:
-                    _, items = self._fetch_q.get_nowait()
-                except asyncio.QueueEmpty:
-                    break
-                for it in items:
-                    if not it[_FUT].done():
-                        it[_FUT].set_exception(err)
-            self._fetch_q = None
-        for stage in (self._collecting, self._fetching):
-            for it in stage or ():
+
+        def fail(items) -> None:
+            for it in items or ():
                 if not it[_FUT].done():
                     it[_FUT].set_exception(err)
-        self._collecting = self._fetching = None
-        for it in self.queue.drain():
-            if not it[_FUT].done():
-                it[_FUT].set_exception(err)
+
+        for lane in self.plane.lanes:
+            if lane.fetch_q is not None:
+                while True:
+                    try:
+                        _, items = lane.fetch_q.get_nowait()
+                    except asyncio.QueueEmpty:
+                        break
+                    fail(items)
+                lane.fetch_q = None
+            if lane.q is not None:
+                while True:
+                    try:
+                        items = lane.q.get_nowait()
+                    except asyncio.QueueEmpty:
+                        break
+                    fail(items)
+                lane.q = None
+            fail(lane.dispatching)
+            fail(lane.fetching)
+            lane.dispatching = lane.fetching = None
+        fail(self._collector_held)
+        self._collector_held = None
+        fail(self.queue.drain())
         # coalesced duplicates chained onto a failed primary are resolved by
         # their done-callbacks when the loop next runs; nothing to do here
         self._inflight.clear()
+
+    # -- back-compat observation surface (telemetry + stop()-era tests) ----
+
+    @property
+    def _collecting(self) -> list | None:
+        """The batch (if any) currently between the tenant queue and a
+        lane's fetch window — collector hand-off or dispatch in flight."""
+        if self._collector_held is not None:
+            return self._collector_held
+        for lane in self.plane.lanes:
+            if lane.dispatching is not None:
+                return lane.dispatching
+        return None
+
+    @property
+    def _fetching(self) -> list | None:
+        for lane in self.plane.lanes:
+            if lane.fetching is not None:
+                return lane.fetching
+        return None
+
+    @property
+    def _fetch_q(self) -> asyncio.Queue | None:
+        """Lane 0's in-flight window (single-chip back-compat; telemetry
+        prefers `inflight_launches()` which sums the fleet)."""
+        return self.plane.lanes[0].fetch_q
+
+    def inflight_launches(self) -> int:
+        """Dispatched launches whose verdicts haven't landed, fleet-wide."""
+        return self.plane.inflight_launches()
 
     async def verify(
         self, msg, pubkeys, requests, session: str = ""
@@ -318,19 +391,31 @@ class BatchVerifierService:
             by_msg.setdefault(it[_MSG], []).append(it)
         return list(by_msg.values())
 
-    def _launch_call(self, items: list):
+    def _launch_call(self, lane: DeviceLane, items: list):
         """The device call for one launch group (runs in an executor)."""
-        if hasattr(self.device, "dispatch_multi"):
+        if hasattr(lane.engine, "dispatch_multi"):
             return partial(
-                self.device.dispatch_multi,
+                lane.engine.dispatch_multi,
                 [(it[_MSG], it[_PUBKEYS], it[_BITSET], it[_SIG])
                  for it in items],
             )
         return partial(
-            self.device.dispatch,
+            lane.engine.dispatch,
             items[0][_MSG],
             [(it[_BITSET], it[_SIG]) for it in items],
         )
+
+    async def _acquire_lane(self) -> DeviceLane | None:
+        """Reserve the least-loaded free lane, waiting for one to free up
+        when every admissible lane is occupied. None means every lane's
+        breaker is open — the caller routes the group to failover (the
+        single-chip breaker-open behavior, fleet-wide)."""
+        while True:
+            lane = self.plane.pick()
+            if lane is not None or not self.plane.allowed():
+                return lane
+            self._free.clear()
+            await self._free.wait()
 
     async def _collector(self) -> None:
         while True:
@@ -341,75 +426,117 @@ class BatchVerifierService:
             # share the launch
             if len(self.queue) < self.device.batch_size:
                 await asyncio.sleep(self.max_delay)
+            # reserve a dispatch slot BEFORE draining the tenant queue:
+            # while every lane is occupied, pending work stays in the
+            # tenant queue where fairness, admission bounds and
+            # forget_session() can still reach it
+            lane = await self._acquire_lane()
             batch = self.queue.take(self.device.batch_size)
             if not batch:
                 continue
-            # from here until every group is handed to _fetch_q the batch
-            # lives in neither the queue nor _fetch_q: track it on self so
-            # stop() can fail these futures if this task is cancelled
-            self._collecting = batch
-            for items in self._plan_launches(batch):
-                handle = None
-                if self.breaker.allow():
-                    # dispatch only (host prep + async enqueue) — the fetch
-                    # stage blocks on the verdicts so this loop can already
-                    # build and dispatch the next launch. Transient errors
-                    # retry with capped exponential backoff; each failure
-                    # feeds the breaker.
-                    t0 = trace_now()
-                    handle = await self._dispatch_with_retries(
-                        self._launch_call(items)
-                    )
-                    if self.rec is not None and self.rec.enabled:
-                        # the host half of a launch: request packing + the
-                        # async enqueue (PR 1's host_pack_ms lives in here)
-                        self.rec.span(
-                            "dispatch_pack",
-                            t0,
-                            trace_now(),
-                            tid=SERVICE_TID,
-                            cat="verifier",
-                            args={"n": len(items), "ok": handle is not None},
-                        )
-                if handle is None:
-                    # breaker open, or retries exhausted: host failover
-                    # (or fail the futures when no fallback exists)
+            # from here until every group is handed to a lane the batch
+            # lives in neither the queue nor any lane structure: track it
+            # on self so stop() can fail these futures if this task is
+            # cancelled mid-hand-off
+            self._collector_held = batch
+            for i, items in enumerate(self._plan_launches(batch)):
+                if i:
+                    lane = await self._acquire_lane()
+                if lane is None:
+                    # every breaker open: host failover (or fail the
+                    # futures when no fallback exists)
                     await self._failover(items)
                     continue
+                # mark BEFORE the put: `dispatching` is both the lane's
+                # occupied flag and stop()'s handle on the group (the queue
+                # item is the same list object, so a drain double-fail is a
+                # no-op). No await between pick and put -> put_nowait is
+                # safe on the capacity-1 cell.
+                lane.dispatching = items
+                lane.q.put_nowait(items)
+            self._collector_held = None
+
+    async def _lane_dispatcher(self, lane: DeviceLane) -> None:
+        """Per-lane first pipeline stage: dispatch groups handed to this
+        lane (host prep + async enqueue), then push the handle into the
+        lane's in-flight window. Blocking on a full window keeps the lane
+        marked occupied — that is the per-chip backpressure."""
+        while True:
+            items = await lane.q.get()
+            handle = None
+            if lane.breaker.allow():
+                t0 = trace_now()
+                handle = await self._dispatch_with_retries(
+                    lane, self._launch_call(lane, items)
+                )
+                if self.rec is not None and self.rec.enabled:
+                    # the host half of a launch: request packing + the
+                    # async enqueue (PR 1's host_pack_ms lives in here)
+                    self.rec.span(
+                        "dispatch_pack",
+                        t0,
+                        trace_now(),
+                        tid=SERVICE_TID,
+                        cat="verifier",
+                        args={
+                            "n": len(items),
+                            "ok": handle is not None,
+                            "device": lane.index,
+                        },
+                    )
+            if handle is None:
+                # this lane's breaker opened (or retries exhausted): the
+                # group fails over; FUTURE groups go to other lanes
+                await self._failover(items)
+            else:
                 # launch fill: occupied lanes over lane capacity, recorded
-                # per dispatched launch (the coalescing win metric)
-                self.last_fill = len(items) / self.device.batch_size
-                self.fill_sum += self.last_fill
+                # per dispatched launch (the coalescing win metric), on
+                # both the service aggregate and the device-labeled row
+                fill = len(items) / self.device.batch_size
+                self.last_fill = fill
+                self.fill_sum += fill
                 self.fill_launches += 1
+                lane.last_fill = fill
+                lane.fill_sum += fill
+                lane.launches += 1
+                lane.candidates += len(items)
                 if len({it[_MSG] for it in items}) > 1:
                     self.coalesced_launches += 1
-                await self._fetch_q.put((handle, items))
-            self._collecting = None
+                await lane.fetch_q.put((handle, items))
+            lane.dispatching = None
+            self._free.set()
 
-    async def _dispatch_with_retries(self, call):
-        """Try the device up to 1 + retry_limit times; None = gave up."""
+    async def _dispatch_with_retries(self, lane: DeviceLane, call):
+        """Try the lane's device up to 1 + retry_limit times; None = gave
+        up (each failure feeds THAT lane's breaker)."""
         loop = asyncio.get_running_loop()
         for attempt in range(1 + self.retry_limit):
             try:
                 return await loop.run_in_executor(None, call)
             except asyncio.CancelledError:
-                raise  # stop() fails the futures via _collecting
+                raise  # stop() fails the futures via lane.dispatching
             except Exception as e:
-                self.breaker.record_failure()
+                lane.breaker.record_failure()
                 if self.rec is not None:
                     self.rec.instant(
                         "device_error",
                         tid=SERVICE_TID,
                         cat="verifier",
-                        args={"stage": "dispatch", "breaker": self.breaker.state},
+                        args={
+                            "stage": "dispatch",
+                            "device": lane.index,
+                            "breaker": lane.breaker.state,
+                        },
                     )
                 self.log.warn(
                     "verifier_device_error",
-                    f"dispatch attempt {attempt + 1}: {e}",
+                    f"dispatch attempt {attempt + 1} "
+                    f"(device {lane.index}): {e}",
                 )
-                if not self.breaker.allow() or attempt >= self.retry_limit:
+                if not lane.breaker.allow() or attempt >= self.retry_limit:
                     return None
                 self.device_retries += 1
+                lane.retries += 1
                 await asyncio.sleep(
                     min(self.backoff_base_s * 2**attempt, self.backoff_cap_s)
                 )
@@ -432,7 +559,10 @@ class BatchVerifierService:
                 "verifier_failover",
                 tid=SERVICE_TID,
                 cat="verifier",
-                args={"n": len(items), "breaker": self.breaker.state},
+                args={
+                    "n": len(items),
+                    "devices_available": len(self.plane.allowed()),
+                },
             )
         by_msg: dict[bytes, list] = {}
         for it in items:
@@ -459,29 +589,32 @@ class BatchVerifierService:
                 if not it[_FUT].done():
                     it[_FUT].set_result(bool(ok))
 
-    async def _fetcher(self) -> None:
-        """Second pipeline stage: pull verdicts for dispatched launches, in
-        dispatch order, and resolve the waiters."""
+    async def _lane_fetcher(self, lane: DeviceLane) -> None:
+        """Per-lane second pipeline stage: pull verdicts for this lane's
+        dispatched launches, in dispatch order, and resolve the waiters."""
         loop = asyncio.get_running_loop()
         while True:
-            handle, items = await self._fetch_q.get()
-            # outside _fetch_q until resolved: visible to stop() (see
+            handle, items = await lane.fetch_q.get()
+            # outside the window until resolved: visible to stop() (see
             # _collector's mirror note)
-            self._fetching = items
+            lane.fetching = items
             t0 = trace_now()
             try:
                 verdicts = await loop.run_in_executor(
-                    None, partial(self.device.fetch, handle)
+                    None, partial(lane.engine.fetch, handle)
                 )
             except asyncio.CancelledError:
-                raise  # stop() fails the futures via _fetching
+                raise  # stop() fails the futures via lane.fetching
             except Exception as e:
                 # a fetch-side device death (verdict transfer failed) takes
                 # the same breaker + host-failover path as dispatch errors
-                self.breaker.record_failure()
-                self.log.warn("verifier_device_error", f"fetch: {e}")
+                lane.breaker.record_failure()
+                self.log.warn(
+                    "verifier_device_error",
+                    f"fetch (device {lane.index}): {e}",
+                )
                 await self._failover(items)
-                self._fetching = None
+                lane.fetching = None
                 continue
             if self.rec is not None and self.rec.enabled:
                 # device wall per launch (verdict-arrival latency), the
@@ -492,15 +625,16 @@ class BatchVerifierService:
                     trace_now(),
                     tid=SERVICE_TID,
                     cat="verifier",
-                    args={"n": len(items)},
+                    args={"n": len(items), "device": lane.index},
                 )
-            self.breaker.record_success()
+            lane.breaker.record_success()
+            lane.fetched += 1
             self.launches += 1
             self.candidates += len(items)
             for it, ok in zip(items, verdicts):
                 if not it[_FUT].done():
                     it[_FUT].set_result(ok)
-            self._fetching = None
+            lane.fetching = None
 
     def session_values(self) -> dict[str, dict[str, float]]:
         """Per-tenant reporter surface for the `session`-labeled metrics
@@ -521,10 +655,12 @@ class BatchVerifierService:
         return {"queueDepth"}
 
     def values(self) -> dict[str, float]:
-        pack_ms = float(getattr(self.device, "host_pack_ms", 0.0))
-        pack_n = float(getattr(self.device, "host_pack_launches", 0))
-        disp_ms = float(getattr(self.device, "host_dispatch_ms", 0.0))
-        disp_n = float(getattr(self.device, "host_dispatch_launches", 0))
+        # host pack/dispatch accounting SUMMED over the fleet's engines
+        # (it used to read the counters off device 0 only — wrong the
+        # moment a second chip dispatched anything)
+        hc = self.plane.host_cost()
+        pack_ms, pack_n = hc["pack_ms"], hc["pack_launches"]
+        disp_ms, disp_n = hc["dispatch_ms"], hc["dispatch_launches"]
         return {
             "verifierLaunches": float(self.launches),
             "verifierCandidates": float(self.candidates),
@@ -562,14 +698,18 @@ class BatchVerifierService:
             "hostDispatchMs": disp_ms,
             "hostDispatchLaunches": disp_n,
             "hostDispatchMsPerLaunch": disp_ms / disp_n if disp_n else 0.0,
-            # resilience plane: breaker + host-failover counters
-            "breakerState": {"closed": 0.0, "half-open": 0.5, "open": 1.0}[
-                self.breaker.state
-            ],
-            "breakerOpenCt": float(self.breaker.open_count),
+            # resilience plane: worst lane state + fleet-summed counters
+            "breakerState": max(
+                BREAKER_CODE[l.breaker.state] for l in self.plane.lanes
+            ),
+            "breakerOpenCt": float(
+                sum(l.breaker.open_count for l in self.plane.lanes)
+            ),
             "deviceRetryCt": float(self.device_retries),
             "failoverBatches": float(self.failover_batches),
             "failoverCandidates": float(self.failover_candidates),
+            # fleet plane: lane count, admissible lanes, scheduler audit
+            **self.plane.values(),
             # process-wide dedup plane (monitor keys: verifier_dedup*)
             **self.cache.values(),
         }
@@ -585,4 +725,6 @@ class BatchVerifierService:
             "verifierQueueDepth",
             "hostPackMsPerLaunch",
             "hostDispatchMsPerLaunch",
+            "devicesTotal",
+            "devicesAvailable",
         } | self.cache.gauge_keys()

@@ -1,0 +1,237 @@
+"""Fleet-of-chips device plane: K devices behind one verifier service.
+
+ROADMAP item 2 ("standing ceiling"): every service launch used to land on
+one chip, so the 8-device mesh kernels compiled by the MULTICHIP gate were
+never fed by a real dispatch path. A `DevicePlane` owns K device engines —
+real mesh chips, or host devices forced via
+`XLA_FLAGS=--xla_force_host_platform_device_count=8` so the whole plane is
+testable on a CPU-only CI box — and gives each one a `DeviceLane`: its own
+dispatch hand-off cell, in-flight fetch window, circuit breaker, and
+occupancy counters. `BatchVerifierService` schedules launch groups onto
+lanes least-loaded-first, so fetch latency on one chip never idles the
+others; a lane whose breaker opens simply stops receiving work until its
+cooldown probe succeeds (degrade to K-1 chips, not to zero).
+
+The plane is also the fleet's reporter surface: `values()` sums the
+per-engine host pack/dispatch costs (the service used to read them off
+device 0 only), and `labeled_values()` exposes one row per device for the
+`device`-labeled metrics dimension beside `session`
+(`handel_device_verifier_launches{device="3"}`).
+
+This module must import neither jax nor the service driver at module level
+— fake-crypto simulations construct planes of host stubs in processes that
+never touch jax. The jax-backed builder (`bn254_plane`) imports lazily.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from handel_tpu.utils.breaker import CircuitBreaker
+
+__all__ = ["DeviceLane", "DevicePlane", "bn254_plane", "host_plane"]
+
+#: breaker state -> exposition value (shared with BatchVerifierService)
+BREAKER_CODE = {"closed": 0.0, "half-open": 0.5, "open": 1.0}
+
+
+class DeviceLane:
+    """One chip of the plane: an engine plus everything the scheduler needs
+    to route around it — hand-off cell, in-flight window, breaker, and
+    per-device counters. The asyncio queues are created by the service at
+    start() (they must bind to its event loop) and torn down at stop().
+
+    `dispatching` holds the launch group from the moment the scheduler
+    hands it to this lane until its handle reaches `fetch_q` (or it fails
+    over): while set, the lane's dispatch slot is occupied AND stop() can
+    fail the group's futures. `fetching` mirrors it for the fetch stage.
+    """
+
+    __slots__ = (
+        "index", "engine", "breaker", "q", "fetch_q", "dispatching",
+        "fetching", "launches", "candidates", "fill_sum", "last_fill",
+        "retries", "fetched",
+    )
+
+    def __init__(self, index: int, engine, breaker: CircuitBreaker | None = None):
+        self.index = index
+        self.engine = engine
+        self.breaker = breaker or CircuitBreaker()
+        self.q: asyncio.Queue | None = None
+        self.fetch_q: asyncio.Queue | None = None
+        self.dispatching: list | None = None
+        self.fetching: list | None = None
+        self.launches = 0
+        self.candidates = 0
+        self.fill_sum = 0.0
+        self.last_fill = 0.0
+        self.retries = 0
+        self.fetched = 0
+
+    def free(self) -> bool:
+        """Can accept a launch group right now (dispatch slot empty)."""
+        return self.dispatching is None
+
+    def inflight(self) -> int:
+        """Launches dispatched to the device whose verdicts haven't landed."""
+        n = 1 if self.fetching is not None else 0
+        if self.fetch_q is not None:
+            n += self.fetch_q.qsize()
+        return n
+
+    def load(self) -> int:
+        """Launches this lane is responsible for right now — the scheduling
+        key: queued/dispatching + awaiting fetch."""
+        return (1 if self.dispatching is not None else 0) + self.inflight()
+
+    def values(self) -> dict[str, float]:
+        """One `device`-labeled metrics row."""
+        return {
+            "launches": float(self.launches),
+            "candidates": float(self.candidates),
+            "fillRatio": (
+                self.fill_sum / self.launches if self.launches else 0.0
+            ),
+            "lastFill": self.last_fill,
+            "inflight": float(self.inflight()),
+            "load": float(self.load()),
+            "retries": float(self.retries),
+            "breakerState": BREAKER_CODE[self.breaker.state],
+            "breakerOpenCt": float(self.breaker.open_count),
+        }
+
+
+class DevicePlane:
+    """K `DeviceLane`s and the least-loaded-first pick over them.
+
+    `pick()` returns the least-loaded FREE lane among those whose breaker
+    admits work, or None when every admissible lane is occupied (the
+    caller waits) — so an idle chip is always preferred over queueing
+    behind a busy one. `sched_picks`/`idle_violations` audit exactly the
+    acceptance property "no device idles while another has ≥ 2 queued
+    launches": a violation is counted iff an idle admissible lane existed,
+    some lane carried ≥ 2 launches, and the pick was NOT idle — impossible
+    under min-load, so the bench asserts the counter stays 0.
+    """
+
+    def __init__(self, engines, breakers=None):
+        engines = list(engines)
+        if not engines:
+            raise ValueError("DevicePlane needs at least one device engine")
+        if breakers is not None and len(breakers) != len(engines):
+            raise ValueError("breakers must match engines 1:1")
+        self.lanes = [
+            DeviceLane(i, eng, breakers[i] if breakers else None)
+            for i, eng in enumerate(engines)
+        ]
+        self.sched_picks = 0
+        self.idle_violations = 0
+
+    def __len__(self) -> int:
+        return len(self.lanes)
+
+    @property
+    def batch_size(self) -> int:
+        return self.lanes[0].engine.batch_size
+
+    def allowed(self) -> list[DeviceLane]:
+        """Lanes whose breaker currently admits launches."""
+        return [l for l in self.lanes if l.breaker.allow()]
+
+    def pick(self) -> DeviceLane | None:
+        """Least-loaded free admissible lane; None when none is free."""
+        allowed = self.allowed()
+        free = [l for l in allowed if l.free()]
+        if not free:
+            return None
+        lane = min(free, key=lambda l: (l.load(), l.index))
+        self.sched_picks += 1
+        if (
+            lane.load() > 0
+            and any(l.load() == 0 for l in allowed)
+            and any(l.load() >= 2 for l in self.lanes)
+        ):
+            self.idle_violations += 1
+        return lane
+
+    def inflight_launches(self) -> int:
+        return sum(l.inflight() for l in self.lanes)
+
+    def host_cost(self) -> dict[str, float]:
+        """Per-launch host accounting SUMMED over the fleet's engines (the
+        service used to read the counters off device 0 only)."""
+        out = {"pack_ms": 0.0, "pack_launches": 0.0,
+               "dispatch_ms": 0.0, "dispatch_launches": 0.0}
+        for lane in self.lanes:
+            eng = lane.engine
+            out["pack_ms"] += float(getattr(eng, "host_pack_ms", 0.0))
+            out["pack_launches"] += float(
+                getattr(eng, "host_pack_launches", 0)
+            )
+            out["dispatch_ms"] += float(
+                getattr(eng, "host_dispatch_ms", 0.0)
+            )
+            out["dispatch_launches"] += float(
+                getattr(eng, "host_dispatch_launches", 0)
+            )
+        return out
+
+    def values(self) -> dict[str, float]:
+        """Fleet aggregates (folded into the service's values())."""
+        return {
+            "devicesTotal": float(len(self.lanes)),
+            "devicesAvailable": float(len(self.allowed())),
+            "schedPicks": float(self.sched_picks),
+            "schedIdleViolations": float(self.idle_violations),
+        }
+
+    def labeled_values(self) -> dict[str, dict[str, float]]:
+        """Per-device rows for the `device` label dimension
+        (core/metrics.py register_labeled_values(label="device"))."""
+        return {str(l.index): l.values() for l in self.lanes}
+
+    def labeled_gauge_keys(self) -> set[str]:
+        return {"fillRatio", "lastFill", "inflight", "load", "breakerState"}
+
+
+def host_plane(constructor, devices: int, batch_size: int = 64,
+               launch_ms: float = 0.0) -> DevicePlane:
+    """A plane of K host-math engines (service/driver.py HostDevice) — the
+    CI/bench shape: real scheduling + breakers, no kernels compiled."""
+    from handel_tpu.service.driver import HostDevice
+
+    return DevicePlane([
+        HostDevice(constructor, batch_size=batch_size, launch_ms=launch_ms)
+        for _ in range(max(1, devices))
+    ])
+
+
+def bn254_plane(registry_pubkeys, devices: int, batch_size: int = 16,
+                curves=None, warmup: bool = False) -> DevicePlane:
+    """A plane of K BN254 engines, one pinned to each visible jax device.
+    Each engine commits the registry to ITS chip once at startup (the
+    single-chip resident-registry pattern, per device). Warmup is off by
+    default: pairing-tail compiles are minutes each — smokes drive the
+    aggregation stage only, exactly like scripts/launch_smoke.py."""
+    import jax
+
+    from handel_tpu.models.bn254_jax import BN254Device
+    from handel_tpu.ops.curve import BN254Curves
+
+    devs = jax.devices()
+    if devices > len(devs):
+        raise ValueError(
+            f"requested {devices} devices but only {len(devs)} visible "
+            "(set XLA_FLAGS=--xla_force_host_platform_device_count=N)"
+        )
+    shared = curves or BN254Curves()
+    engines = []
+    for i in range(max(1, devices)):
+        eng = BN254Device(
+            registry_pubkeys, batch_size=batch_size, curves=shared,
+            jax_device=devs[i],
+        )
+        if warmup:
+            eng.warmup()
+        engines.append(eng)
+    return DevicePlane(engines)

@@ -128,14 +128,53 @@ def sharded_masked_sum_g2(
     )
 
     def padded(reg_x0, reg_x1, reg_y0, reg_y1, mask):
-        if pad_n:
+        # registries arriving PRE-PADDED to the device multiple — the
+        # mesh-resident commit from `commit_registry_sharded` — skip the
+        # pad and keep their committed shards (no per-launch re-shard);
+        # unpadded arrays take the historical pad-inside-jit path. The
+        # branch is on static shapes, so each caller traces exactly one
+        # of the two forms.
+        if pad_n and reg_x0.shape[1] == n_registry:
             pad_pt = lambda a: jnp.pad(a, ((0, 0), (0, pad_n)), mode="edge")
             reg_x0, reg_x1 = pad_pt(reg_x0), pad_pt(reg_x1)
             reg_y0, reg_y1 = pad_pt(reg_y0), pad_pt(reg_y1)
+        if pad_n:
             mask = jnp.pad(mask, ((0, pad_n), (0, 0)))  # padded rows: False
         return fn(reg_x0, reg_x1, reg_y0, reg_y1, mask)
 
     return jax.jit(padded)
+
+
+def commit_registry_sharded(
+    mesh: Mesh, reg_x, reg_y, n_registry: int, axis: str = "dp"
+):
+    """Commit a registry's (L, N) G2 coordinate arrays to the mesh ONCE,
+    one shard per device — the multi-chip counterpart of the single-chip
+    resident-registry commit in models/bn254_jax.py.
+
+    Pads to the device multiple on the host (edge-replicated points, same
+    rule as `sharded_masked_sum_g2`'s internal pad — the padded columns are
+    masked out of every sum) and `device_put`s with the registry-axis
+    NamedSharding, so `sharded_masked_sum_g2` sees already-placed shards
+    instead of re-sharding the full replicated arrays every launch.
+    Returns ((x0, x1), (y0, y1)) committed arrays.
+    """
+    from jax.sharding import NamedSharding
+
+    ndev = mesh.shape[axis]
+    pad_n = (-n_registry) % ndev
+    sh = NamedSharding(mesh, P(None, axis))
+
+    def put(a):
+        a = np.asarray(a)
+        if pad_n:
+            a = np.pad(a, ((0, 0), (0, pad_n)), mode="edge")
+        return jax.device_put(a, sh)
+
+    return (
+        (put(reg_x[0]), put(reg_x[1])),
+        (put(reg_y[0]), put(reg_y[1])),
+    )
 
 
 def sharded_pairing_check(

@@ -109,6 +109,8 @@ class DeviceTelemetry:
             "dispatchQueueDepth": 0.0,
             "inflightLaunches": 0.0,
             "breakerState": 0.0,
+            "deviceLanes": 0.0,
+            "deviceLanesAvailable": 0.0,
             "profileCaptures": float(self.profile_captures),
         }
         jax = self._jax()
@@ -132,11 +134,26 @@ class DeviceTelemetry:
         svc = self.service
         if svc is not None:
             out["dispatchQueueDepth"] = float(svc.queue_depth())
-            q = svc._fetch_q
-            out["inflightLaunches"] = float(q.qsize()) if q is not None else 0.0
+            # fleet-aware in-flight count (parallel/plane.py): sums every
+            # lane's window, not just device 0's. Stub services without the
+            # method fall back to the single _fetch_q.
+            infl = getattr(svc, "inflight_launches", None)
+            if callable(infl):
+                out["inflightLaunches"] = float(infl())
+            else:
+                q = getattr(svc, "_fetch_q", None)
+                out["inflightLaunches"] = (
+                    float(q.qsize()) if q is not None else 0.0
+                )
             out["breakerState"] = {
                 "closed": 0.0, "half-open": 0.5, "open": 1.0
             }[svc.breaker.state]
+            plane = getattr(svc, "plane", None)
+            if plane is not None:
+                out["deviceLanes"] = float(len(plane.lanes))
+                out["deviceLanesAvailable"] = float(len(plane.allowed()))
+            else:
+                out["deviceLanes"] = out["deviceLanesAvailable"] = 1.0
         return out
 
     def gauge_keys(self) -> set[str]:
@@ -144,6 +161,7 @@ class DeviceTelemetry:
         return {
             "liveArrays", "liveArrayBytes", "memBytesInUse",
             "dispatchQueueDepth", "inflightLaunches", "breakerState",
+            "deviceLanes", "deviceLanesAvailable",
         }
 
     # -- profiler capture (POST /debug/profile) ------------------------------

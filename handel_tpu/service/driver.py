@@ -91,6 +91,7 @@ class MultiSessionCluster:
         metrics_port: int | None = None,
         seed_base: int = 0,
         config_tweak=None,
+        devices: int = 1,
     ):
         self.k = sessions
         self.nodes = nodes
@@ -99,9 +100,20 @@ class MultiSessionCluster:
         self.seed_base = seed_base
         self.config_tweak = config_tweak
         scheme = scheme or FakeScheme()
-        device = device or HostDevice(
-            scheme.constructor, batch_size=batch_size
-        )
+        if device is None:
+            if devices > 1:
+                # fleet-of-chips serve path ([service] devices = N): one
+                # host engine per lane, scheduled least-loaded-first
+                # (parallel/plane.py) so the tenant queue fills K chips
+                from handel_tpu.parallel.plane import host_plane
+
+                device = host_plane(
+                    scheme.constructor, devices, batch_size=batch_size
+                )
+            else:
+                device = HostDevice(
+                    scheme.constructor, batch_size=batch_size
+                )
         self.service = BatchVerifierService(
             device,
             max_delay_ms=max_delay_ms,
@@ -128,6 +140,11 @@ class MultiSessionCluster:
 
             reg = MetricsRegistry()
             reg.register_values("device_verifier", self.service)
+            # per-device rows beside the session dimension: one sample per
+            # plane lane, e.g. handel_device_verifier_launches{device="3"}
+            reg.register_labeled_values(
+                "device_verifier", self.service.plane, label="device"
+            )
             reg.register_values("service", self.manager)
             reg.register_labeled_values(
                 "service",
@@ -185,6 +202,15 @@ class MultiSessionCluster:
             "coalesced_launches": int(sv["coalescedLaunches"]),
             "dedup_hit_rate": round(sv["dedupHitRate"], 4),
             "admission_refused": int(sv["admissionRefused"]),
+            # fleet plane: per-device launch counts (multichip smoke
+            # asserts every device dispatched) + the scheduler audit
+            "devices": len(self.service.plane),
+            "device_launches": [
+                lane.launches for lane in self.service.plane.lanes
+            ],
+            "sched_idle_violations": int(
+                self.service.plane.idle_violations
+            ),
         }
 
     def stop(self) -> None:
@@ -226,6 +252,7 @@ async def run_in_process(cfg, *, seed_base: int = 0,
         p.nodes,
         threshold=p.threshold or None,
         scheme=scheme,
+        devices=p.devices,
         batch_size=p.batch_size or cfg.batch_size,
         max_sessions=p.max_sessions or None,
         session_ttl_s=p.session_ttl_s,
@@ -262,6 +289,15 @@ def merge_summaries(parts: list[dict]) -> dict:
         "verifier_candidates": sum(p["verifier_candidates"] for p in parts),
         "coalesced_launches": sum(p["coalesced_launches"] for p in parts),
         "admission_refused": sum(p["admission_refused"] for p in parts),
+        # fleet plane: each worker owns its own device plane, so the rows
+        # concatenate (older workers without the keys contribute nothing)
+        "devices": sum(p.get("devices", 1) for p in parts),
+        "device_launches": [
+            n for p in parts for n in p.get("device_launches", [])
+        ],
+        "sched_idle_violations": sum(
+            p.get("sched_idle_violations", 0) for p in parts
+        ),
         "workers": len(parts),
     }
     launches = out["verifier_launches"]

@@ -128,6 +128,7 @@ class ServiceParams:
     nodes: int = 16
     threshold: int = 0  # 0 -> default percentage of `nodes`
     processes: int = 1  # worker node-processes the sessions shard over
+    devices: int = 1  # verifier plane lanes (DevicePlane) per process
     max_sessions: int = 0  # live-session admission cap; 0 -> `sessions`
     session_ttl_s: float = 60.0  # running session expiry deadline
     quantum: int = 8  # DRR lane credits per tenant ring visit
@@ -239,6 +240,7 @@ def load_config(path: str) -> SimConfig:
         nodes=int(sv.get("nodes", 16)),
         threshold=int(sv.get("threshold", 0)),
         processes=int(sv.get("processes", 1)),
+        devices=int(sv.get("devices", 1)),
         max_sessions=int(sv.get("max_sessions", 0)),
         session_ttl_s=float(sv.get("session_ttl_s", 60.0)),
         quantum=int(sv.get("quantum", 8)),
@@ -329,6 +331,7 @@ def dump_config(cfg: SimConfig) -> str:
             f"nodes = {cfg.service.nodes}",
             f"threshold = {cfg.service.threshold}",
             f"processes = {cfg.service.processes}",
+            f"devices = {cfg.service.devices}",
             f"max_sessions = {cfg.service.max_sessions}",
             f"session_ttl_s = {cfg.service.session_ttl_s}",
             f"quantum = {cfg.service.quantum}",

@@ -117,9 +117,13 @@ def aggregate(scrapes: list[dict]) -> dict:
         s = _samples(fams, name)
         return sum(v for _, v in s) / len(s) if s else None
 
-    breaker = [v for _, v in _samples(
+    # per-device breaker rows (the fleet plane) are rendered separately;
+    # keep this count at one entry per verifier service
+    breaker = [v for labels, v in _samples(
         fams, "handel_device_verifier_breaker_state"
-    )] + [v for _, v in _samples(fams, "handel_device_breaker_state")]
+    ) if "device" not in labels] + [
+        v for _, v in _samples(fams, "handel_device_breaker_state")
+    ]
 
     # multi-tenant service plane (handel_tpu/service/): per-session rows
     # keyed by the `session` label dimension, plus the manager aggregates
@@ -138,12 +142,31 @@ def aggregate(scrapes: list[dict]) -> dict:
             if sid:
                 sessions.setdefault(sid, {})[field] = v
 
+    # fleet-of-chips verifier plane (parallel/plane.py): per-device rows
+    # keyed by the `device` label dimension beside the session axis
+    devices: dict[str, dict] = {}
+    for field, name in (
+        ("launches", "handel_device_verifier_launches"),
+        ("candidates", "handel_device_verifier_candidates"),
+        ("fill", "handel_device_verifier_fill_ratio"),
+        ("last_fill", "handel_device_verifier_last_fill"),
+        ("inflight", "handel_device_verifier_inflight"),
+        ("load", "handel_device_verifier_load"),
+        ("retries", "handel_device_verifier_retries"),
+        ("breaker", "handel_device_verifier_breaker_state"),
+    ):
+        for labels, v in _samples(fams, name):
+            did = labels.get("device")
+            if did is not None:
+                devices.setdefault(did, {})[field] = v
+
     def first(name):
         s = _samples(fams, name)
         return s[0][1] if s else None
 
     return {
         "sessions": sessions,
+        "devices": devices,
         "service_live": total("handel_service_sessions_live"),
         "service_completed": total("handel_service_sessions_completed"),
         "service_expired": total("handel_service_sessions_expired"),
@@ -237,6 +260,31 @@ def render_sessions(model: dict) -> list[str]:
     return lines
 
 
+_BREAKER_NAMES = {0.0: "closed", 0.5: "half", 1.0: "open"}
+
+
+def render_devices(model: dict) -> list[str]:
+    """Per-device row block (fleet-of-chips verifier plane): occupancy,
+    fill and breaker state per plane lane, from the `device` label."""
+    devices = model.get("devices") or {}
+    if not devices:
+        return []
+    lines = [f"devices  ({len(devices)} verifier lanes)"]
+    for did in sorted(devices, key=lambda d: (len(d), d)):
+        row = devices[did]
+        fill = row.get("fill")
+        breaker = _BREAKER_NAMES.get(row.get("breaker", 0.0), "?")
+        lines.append(
+            f"  dev {did:>3} launches {int(row.get('launches', 0)):>6}"
+            f"  inflight {int(row.get('inflight', 0)):>2}"
+            f"  load {int(row.get('load', 0)):>2}"
+            f"  fill {('--' if fill is None else f'{fill:.2f}')}"
+            f"  retries {int(row.get('retries', 0)):>3}"
+            f"  breaker {breaker}"
+        )
+    return lines
+
+
 def render(model: dict, endpoints: list[str], up: int, tick: int) -> str:
     """One dashboard frame as plain text (the caller adds ANSI)."""
     lines = [
@@ -271,6 +319,10 @@ def render(model: dict, endpoints: list[str], up: int, tick: int) -> str:
     if srows:
         lines.append("")
         lines.extend(srows)
+    drows = render_devices(model)
+    if drows:
+        lines.append("")
+        lines.extend(drows)
     lines.append("")
     lines.append(
         f"verify   p50 {_ms(model['verify_p50'])}  "

@@ -48,6 +48,12 @@ SIDE_METRICS = {
     "aggregates_per_s": "higher",
     "session_p99_s": "lower",
     "launch_fill_ratio": "higher",
+    # fleet-of-chips verify plane (bench.py fleet_bench): K-lane DevicePlane
+    # scheduler throughput, its speedup over an identical 1-lane run, and
+    # the fleet's per-launch lane fill
+    "launches_per_s": "higher",
+    "fleet_speedup_x": "higher",
+    "fleet_fill_ratio": "higher",
 }
 
 

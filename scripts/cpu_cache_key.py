@@ -1,0 +1,46 @@
+"""Print a digest of the host CPU feature set, for XLA cache keys in CI.
+
+The XLA persistent compile cache stores *machine-code* executables. XLA
+refuses (or worse, SIGILLs on older XLA) when an executable compiled on a
+runner with AVX-512 is restored onto a runner without it: GitHub's
+`ubuntu-latest` pool mixes CPU generations, and `runner.os` alone keys all
+of them to the same cache line. Keying on `platform.machine()` plus a
+digest of the CPU flag set partitions the cache per micro-architecture
+feature set, so a restore can only hand an executable to a host able to
+run it.
+
+Usage (CI): `echo "cpukey=$(python scripts/cpu_cache_key.py)" >> "$GITHUB_OUTPUT"`
+Prints a single token like `x86_64-1f2e3d4c` — stable across reboots of
+the same machine type, different across feature-set changes.
+"""
+
+import hashlib
+import platform
+import sys
+
+
+def cpu_flags() -> list[str]:
+    """The CPU feature flags, sorted; empty where /proc/cpuinfo has no
+    flags line (macOS, exotic kernels) — the digest then keys on the
+    machine arch alone, which is strictly no worse than today's key."""
+    try:
+        with open("/proc/cpuinfo", encoding="ascii", errors="replace") as f:
+            for line in f:
+                # x86 calls it "flags", arm64 calls it "Features"
+                if line.lower().startswith(("flags", "features")):
+                    return sorted(set(line.split(":", 1)[1].split()))
+    except OSError:
+        pass
+    return []
+
+
+def cache_key() -> str:
+    digest = hashlib.sha256(
+        " ".join(cpu_flags()).encode("ascii", "replace")
+    ).hexdigest()[:8]
+    return f"{platform.machine()}-{digest}"
+
+
+if __name__ == "__main__":
+    print(cache_key())
+    sys.exit(0)

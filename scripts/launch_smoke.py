@@ -28,6 +28,14 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+# 8 virtual host devices — must land before jax initializes, so the
+# devices-in-{1,8} parametrization below runs on a real multi-device
+# topology (the same one conftest/multichip_smoke force)
+from handel_tpu.utils.jaxenv import apply_platform_env  # noqa: E402
+
+os.environ.setdefault("HANDEL_TPU_PLATFORM", "cpu")
+apply_platform_env(force_host_device_count=8)
+
 import jax  # noqa: E402
 import numpy as np  # noqa: E402
 
@@ -38,6 +46,13 @@ from handel_tpu.models.bn254_jax import BN254Device  # noqa: E402
 from handel_tpu.ops import bn254_ref as bn  # noqa: E402
 
 N, C, LAUNCHES = 12, 4, 8
+# plane sizes the fleet phase covers; override for a quick local run with
+# HANDEL_TPU_SMOKE_DEVICES=1 (each pinned engine pays one XLA compile —
+# persistent-cache-warm in CI after the first push)
+DEVICE_COUNTS = tuple(
+    int(x)
+    for x in os.environ.get("HANDEL_TPU_SMOKE_DEVICES", "1,8").split(",")
+)
 
 
 def host_agg(pks, bs):
@@ -113,6 +128,55 @@ def main() -> int:
         f"(pack {device.host_pack_ms / LAUNCHES:.3f} ms/launch, dispatch "
         f"{device.host_dispatch_ms / LAUNCHES:.3f} ms/launch)"
     )
+
+    # -- fleet parametrization: the same staged aggregation on a plane of
+    # k pinned engines, one launch per device, every aggregate against the
+    # host oracle (devices in {1, 8}; 1 is the measured loop above) -------
+    from handel_tpu.parallel.plane import bn254_plane
+
+    for k in DEVICE_COUNTS:
+        if k <= 1:
+            continue  # the single-device loop above IS the k=1 phase
+        plane = bn254_plane(pks, k, batch_size=C, curves=device.curves)
+        t1 = time.perf_counter()
+        fleet_checked = 0
+        for lane in plane.lanes:
+            eng = lane.engine
+            reqs = []
+            for _ in range(C):
+                size = rng.randrange(2, N)
+                lo = rng.randrange(0, N - size + 1)
+                bs = BitSet(N)
+                for i in range(lo, lo + size):
+                    bs.set(i, True)
+                reqs.append((bs, sig))
+            plan = eng._pack_requests(reqs)
+            agg = eng._range_agg_kernel(plan.miss_k)(
+                *eng._stage_plan(plan)[:4]
+            )
+            placed = {b.device for b in jax.tree_util.tree_leaves(agg)}
+            assert placed == {eng.jax_device}, (
+                f"lane {lane.index}: launch ran on {placed}, "
+                f"pinned to {eng.jax_device}"
+            )
+            lane.launches += 1
+            x, y, inf = eng.curves.g2.to_affine(agg)
+            xs = eng.curves.T.f2_unpack(x)
+            ys = eng.curves.T.f2_unpack(y)
+            infs = np.asarray(inf)
+            for j, (bs, _) in enumerate(reqs):
+                want = host_agg(pks, bs)
+                got = None if infs[j] else (xs[j], ys[j])
+                assert got == want, (
+                    f"lane {lane.index} candidate {j}: aggregate mismatch"
+                )
+                fleet_checked += 1
+        assert all(lane.launches >= 1 for lane in plane.lanes)
+        print(
+            f"launch_smoke: {k}-device plane, one pinned launch per "
+            f"engine, {fleet_checked} aggregates verified in "
+            f"{time.perf_counter() - t1:.1f}s"
+        )
 
     # -- batched combine vs host pairing-library folds ---------------------
     pts = [bn.g1_mul(bn.G1_GEN, rng.randrange(1, bn.R)) for _ in range(8)]

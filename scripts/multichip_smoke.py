@@ -1,0 +1,281 @@
+"""Fleet-of-chips verify-plane smoke: 8 forced host devices, end to end.
+
+Promotes the MULTICHIP dryrun to a CI gate over the wired fleet path
+(parallel/plane.py DevicePlane + the per-lane dispatch queues in
+parallel/batch_verifier.py). Four phases:
+
+1. Kernel fleet: 8 `BN254Device` engines pinned to distinct jax devices
+   (`bn254_plane`), each committing the registry to its own chip, driving
+   the AGGREGATION stage only (the same scope note as launch_smoke.py —
+   pairing tails are the slow tier's job) with the launch-smoke shape
+   (N=12, C=4) so the XLA persistent cache is shared with that gate.
+   Every aggregate key is checked against the host oracle and every
+   device must execute >= 1 launch.
+2. Service fleet: a DevicePlane of 8 host-math engines behind ONE
+   BatchVerifierService — every lane must dispatch >= 1 launch and every
+   verdict must match the scheme's own serial batch_verify.
+3. Degraded fleet: lane 0's breaker forced open before start — the run
+   must complete on the 7 healthy lanes and lane 0 must launch nothing.
+4. Fleet bench gate: bench.py fleet_bench (8 lanes vs identical 1-lane
+   baseline, simulated launch wall) must report >= 4x launches/s, a clean
+   no-idle-while-queued scheduler audit, and survive
+   `scripts/bench_check.py --dry-run` over a fresh artifact carrying
+   launches_per_s / fleet_speedup_x / fleet_fill_ratio.
+"""
+
+import json
+import os
+import random
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# 8 virtual host devices — must land before jax initializes its backends
+from handel_tpu.utils.jaxenv import apply_platform_env  # noqa: E402
+
+os.environ.setdefault("HANDEL_TPU_PLATFORM", "cpu")
+apply_platform_env(force_host_device_count=8)
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+from handel_tpu import native as nat  # noqa: E402
+from handel_tpu.core.bitset import BitSet  # noqa: E402
+from handel_tpu.models.bn254 import BN254PublicKey, BN254Signature  # noqa: E402
+from handel_tpu.ops import bn254_ref as bn  # noqa: E402
+
+N, C, DEVICES = 12, 4, 8
+
+
+def host_agg(pks, bs):
+    acc = None
+    for i in bs.indices():
+        acc = pks[i].point if acc is None else bn.g2_add(acc, pks[i].point)
+    return acc
+
+
+def kernel_fleet_smoke() -> None:
+    """Phase 1: one aggregation launch per pinned BN254 engine, aggregate
+    keys vs the host oracle, every device dispatched."""
+    from handel_tpu.parallel.plane import bn254_plane
+
+    jax.config.update(
+        "jax_compilation_cache_dir", "/tmp/handel_tpu_jax_cache"
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    rng = random.Random(99)
+    sks = [rng.randrange(1, 1 << 20) for _ in range(N)]
+    pks = [BN254PublicKey(p) for p in nat.g2_mul_batch([bn.G2_GEN] * N, sks)]
+    sig = BN254Signature(bn.G1_GEN)
+    assert len(jax.devices()) >= DEVICES, (
+        f"forced host device count not applied: {len(jax.devices())}"
+    )
+    plane = bn254_plane(pks, DEVICES, batch_size=C)
+
+    t0 = time.perf_counter()
+    checked = 0
+    for lane in plane.lanes:
+        device = lane.engine
+        reqs = []
+        for _ in range(C):
+            size = rng.randrange(2, N)
+            lo = rng.randrange(0, N - size + 1)
+            bs = BitSet(N)
+            for i in range(lo, lo + size):
+                bs.set(i, True)
+            reqs.append((bs, sig))
+        plan = device._pack_requests(reqs)
+        args = device._stage_plan(plan)
+        agg = device._range_agg_kernel(plan.miss_k)(*args[:4])
+        # the launch must have executed on THIS lane's pinned chip
+        devs = {b.device for b in jax.tree_util.tree_leaves(agg)}
+        assert devs == {device.jax_device}, (
+            f"lane {lane.index}: launch ran on {devs}, "
+            f"pinned to {device.jax_device}"
+        )
+        lane.launches += 1
+        x, y, inf = device.curves.g2.to_affine(agg)
+        xs = device.curves.T.f2_unpack(x)
+        ys = device.curves.T.f2_unpack(y)
+        infs = np.asarray(inf)
+        for j, (bs, _) in enumerate(reqs):
+            want = host_agg(pks, bs)
+            got = None if infs[j] else (xs[j], ys[j])
+            assert got == want, (
+                f"lane {lane.index} candidate {j}: aggregate mismatch"
+            )
+            checked += 1
+    assert all(lane.launches >= 1 for lane in plane.lanes)
+    print(
+        f"multichip_smoke: {DEVICES} pinned engines, {checked} aggregates "
+        f"verified against the host oracle in "
+        f"{time.perf_counter() - t0:.1f}s"
+    )
+
+
+def _service_run(trip_lane: int | None = None) -> dict:
+    """One fleet service run over 8 host-math lanes; returns per-lane
+    launch counts + verdict check. trip_lane forces that lane's breaker
+    open before the service starts."""
+    import asyncio
+    import concurrent.futures
+
+    from handel_tpu.core.test_harness import FakeScheme
+    from handel_tpu.models.fake import FakePublic, FakeSignature
+    from handel_tpu.parallel.batch_verifier import BatchVerifierService
+    from handel_tpu.parallel.plane import DevicePlane
+    from handel_tpu.service.driver import HostDevice
+    from handel_tpu.utils.breaker import CircuitBreaker
+
+    scheme = FakeScheme()
+    pks = [FakePublic(True) for _ in range(16)]
+    engines = [
+        HostDevice(scheme.constructor, batch_size=4, launch_ms=2.0)
+        for _ in range(DEVICES)
+    ]
+    breakers = [
+        CircuitBreaker(cooldown_s=600.0) for _ in range(DEVICES)
+    ]
+    plane = DevicePlane(engines, breakers=breakers)
+    if trip_lane is not None:
+        br = plane.lanes[trip_lane].breaker
+        for _ in range(br.threshold):
+            br.record_failure()
+        assert not br.allow()
+
+    reqs = []
+    for i in range(96):
+        b = BitSet(16)
+        b.set(i % 16, True)
+        # an invalid signature every 8th request: the verdict check below
+        # must see the scheme's own False, not a blanket True
+        reqs.append(
+            (i.to_bytes(4, "big"), (b, FakeSignature(i % 8 != 7)))
+        )
+    want = [
+        scheme.constructor.batch_verify(msg, pks, [r])[0]
+        for msg, r in reqs
+    ]
+
+    async def go():
+        loop = asyncio.get_running_loop()
+        loop.set_default_executor(
+            concurrent.futures.ThreadPoolExecutor(
+                max_workers=2 * DEVICES + 4
+            )
+        )
+        svc = BatchVerifierService(plane, max_delay_ms=0.2)
+        try:
+            got = await asyncio.gather(
+                *(
+                    svc.verify(msg, pks, [r], session=f"s{i % 4}")
+                    for i, (msg, r) in enumerate(reqs)
+                )
+            )
+            return [v[0] for v in got], svc.values()
+        finally:
+            svc.stop()
+
+    got, vals = asyncio.run(go())
+    assert got == want, "fleet verdicts diverge from the host scheme"
+    return {
+        "per_lane": [lane.engine.dispatched for lane in plane.lanes],
+        "values": vals,
+    }
+
+
+def service_fleet_smoke() -> None:
+    """Phase 2: all 8 lanes dispatch, verdicts match the host scheme."""
+    out = _service_run()
+    per_lane = out["per_lane"]
+    assert all(n >= 1 for n in per_lane), (
+        f"idle lane in a flooded fleet: {per_lane}"
+    )
+    print(
+        f"multichip_smoke: service fleet per-lane launches {per_lane}, "
+        f"fill {out['values']['launchFillRatio']:.2f}"
+    )
+
+
+def degraded_fleet_smoke() -> None:
+    """Phase 3: breaker-open on lane 0 degrades to the 7 healthy lanes."""
+    out = _service_run(trip_lane=0)
+    per_lane = out["per_lane"]
+    assert per_lane[0] == 0, (
+        f"breaker-open lane 0 still dispatched: {per_lane}"
+    )
+    assert all(n >= 1 for n in per_lane[1:]), (
+        f"healthy lane idle in degraded fleet: {per_lane}"
+    )
+    assert out["values"]["devicesAvailable"] == DEVICES - 1
+    assert out["values"]["failoverBatches"] == 0.0
+    print(
+        f"multichip_smoke: degraded fleet completed on {DEVICES - 1} "
+        f"lanes, per-lane launches {per_lane}"
+    )
+
+
+def bench_gate() -> None:
+    """Phase 4: fleet bench >= 4x + clean audit, under bench_check."""
+    from bench import fleet_bench
+
+    fleet = fleet_bench(devices=8, requests_n=160, batch_size=4,
+                        launch_ms=8.0)
+    assert fleet["fleet_speedup_x"] >= 4.0, (
+        f"fleet speedup below the gate: {fleet}"
+    )
+    assert fleet["fleet_idle_violations"] == 0, (
+        f"scheduler idled a device while launches queued: {fleet}"
+    )
+    fresh = {
+        "metric": "fleet_verify_plane_smoke",
+        "value": fleet["launches_per_s"],
+        "unit": "launches/s",
+        "backend": jax.default_backend(),
+        **fleet,
+    }
+    with tempfile.NamedTemporaryFile("w", suffix=".json", delete=False) as f:
+        json.dump(fresh, f)
+        path = f.name
+    try:
+        r = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(REPO, "scripts", "bench_check.py"),
+                "--dry-run",
+                "--fresh",
+                path,
+            ],
+            capture_output=True,
+            text=True,
+        )
+        sys.stdout.write(r.stdout)
+        sys.stderr.write(r.stderr)
+        assert r.returncode == 0, "bench_check --dry-run failed"
+        assert "fleet_speedup_x" in r.stdout, (
+            "bench_check did not consider fleet_speedup_x"
+        )
+    finally:
+        os.unlink(path)
+    print(
+        f"multichip_smoke: fleet bench gated — "
+        f"{fleet['launches_per_s']} launches/s, "
+        f"{fleet['fleet_speedup_x']}x over 1 lane, "
+        f"fill {fleet['fleet_fill_ratio']}"
+    )
+
+
+def main() -> int:
+    kernel_fleet_smoke()
+    service_fleet_smoke()
+    degraded_fleet_smoke()
+    bench_gate()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

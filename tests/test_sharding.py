@@ -81,6 +81,80 @@ def test_sharded_masked_sum_matches_dense_nondivisible():
             )
 
 
+def test_sharded_masked_sum_tiny_registry_empty_shards():
+    """Boundary shape: 5 keys over 8 devices (pads to 8 — shards 5..7 are
+    pure padding) and a 2-candidate batch (fewer lanes than devices). The
+    padding lanes must contribute nothing and the tiny batch must still
+    match the single-device masked sum."""
+    import jax.numpy as jnp
+
+    from handel_tpu.ops.curve import BN254Curves
+    from handel_tpu.parallel.sharding import make_mesh, sharded_masked_sum_g2
+
+    n_reg, batch = 5, 2
+    curves = BN254Curves()
+    T, g2 = curves.T, curves.g2
+    _, pks = _keys(n_reg, seed=13)
+    reg_x = T.f2_pack([p[0] for p in pks])
+    reg_y = T.f2_pack([p[1] for p in pks])
+    mask = np.zeros((n_reg, batch), dtype=bool)
+    mask[:3, 0] = True  # candidate 0: keys {0,1,2}
+    mask[4, 1] = True  # candidate 1: a single key
+
+    mesh = make_mesh(N_DEV)
+    fn = sharded_masked_sum_g2(curves, mesh, n_reg, batch)
+    agg = fn(reg_x[0], reg_x[1], reg_y[0], reg_y[1], jnp.asarray(mask))
+
+    tile = lambda a: jnp.repeat(a, batch, axis=1)
+    P2 = g2.from_affine(
+        (tile(reg_x[0]), tile(reg_x[1])), (tile(reg_y[0]), tile(reg_y[1]))
+    )
+    want = g2.masked_sum(P2, jnp.asarray(mask.reshape(-1)), n_reg)
+    assert not np.asarray(g2.is_infinity(agg)).any()
+    gx, gy, _ = g2.to_affine(agg)
+    wx, wy, _ = g2.to_affine(want)
+    for g, w in ((gx, wx), (gy, wy)):
+        for c in range(2):
+            np.testing.assert_array_equal(np.asarray(g[c]), np.asarray(w[c]))
+
+
+def test_commit_registry_sharded_pads_edge_and_places():
+    """The resident-registry commit (commit_registry_sharded): width padded
+    to the device multiple with edge replication (a real point, so padded
+    lanes never hit the point-at-infinity special case), original columns
+    intact, arrays placed under the mesh's (None, dp) sharding."""
+    from handel_tpu.ops.curve import BN254Curves
+    from handel_tpu.parallel.sharding import (
+        commit_registry_sharded,
+        make_mesh,
+    )
+
+    n_reg = 5  # pads to 8: 3 padded columns
+    curves = BN254Curves()
+    T = curves.T
+    _, pks = _keys(n_reg, seed=17)
+    reg_x = T.f2_pack([p[0] for p in pks])
+    reg_y = T.f2_pack([p[1] for p in pks])
+
+    mesh = make_mesh(N_DEV)
+    (rx0, rx1), (ry0, ry1) = commit_registry_sharded(
+        mesh, reg_x, reg_y, n_reg
+    )
+    for got, src in ((rx0, reg_x[0]), (rx1, reg_x[1]),
+                     (ry0, reg_y[0]), (ry1, reg_y[1])):
+        assert got.shape[1] == N_DEV  # 5 -> 8
+        np.testing.assert_array_equal(
+            np.asarray(got)[:, :n_reg], np.asarray(src)
+        )
+        # edge mode: every padded column replicates the last real key
+        for pad_col in range(n_reg, N_DEV):
+            np.testing.assert_array_equal(
+                np.asarray(got)[:, pad_col], np.asarray(src)[:, -1]
+            )
+        shards = {d.id for d in got.sharding.device_set}
+        assert len(shards) == N_DEV  # spread over the whole mesh
+
+
 @pytest.mark.slow
 def test_device_batch_verify_sharded():
     """The wired path: BN254Device(mesh_devices=8).batch_verify — valid
