@@ -12,6 +12,11 @@ aggregate (aggregate-then-verify, aggregator.go:206 mode). Connectors:
 Packet reuse: gossip rides the same `Packet` wire format with level=255 as
 the baseline marker (the reference uses a dedicated setup level 255 in
 p2p/libp2p/node.go).
+
+Tracing (ISSUE 10 satellite): with a `recorder` attached the baseline emits
+the SAME recv/verify/merge pipeline spans, `net_transit`, send-side flow
+links and `threshold_reached` instant as Handel (core/handel.py), so
+baseline-vs-handel trace comparisons in sim/trace_cli.py are like-for-like.
 """
 
 from __future__ import annotations
@@ -24,6 +29,7 @@ from handel_tpu.core.crypto import Constructor, MultiSignature
 from handel_tpu.core.bitset import BitSet
 from handel_tpu.core.identity import Identity, Registry
 from handel_tpu.core.net import Network, Packet
+from handel_tpu.core.trace import trace_now
 
 GOSSIP_LEVEL = 255
 
@@ -46,6 +52,8 @@ class GossipAggregator:
         fanout: int = 8,
         verify_incoming: bool = True,
         rand: random.Random | None = None,
+        recorder=None,
+        trace_tid: int | None = None,
     ):
         self.net = network
         self.reg = registry
@@ -58,6 +66,12 @@ class GossipAggregator:
         self.fanout = fanout
         self.verify_incoming = verify_incoming
         self.rand = rand or random.Random(identity.id)
+        # flight recorder (core/trace.py), same span names as Handel
+        self.rec = recorder
+        self._tid = trace_tid if trace_tid is not None else identity.id
+        self._span_seq = 0
+        if recorder is not None:
+            recorder.name_thread(self._tid, f"gossip-{identity.id}")
         # known individual signatures by origin (aggregator.go sigs map)
         self.sigs: dict[int, object] = {identity.id: own_sig}
         self.final: asyncio.Future = asyncio.get_event_loop().create_future()
@@ -72,15 +86,86 @@ class GossipAggregator:
             return
         if packet.origin in self.sigs:
             return
+        rec = self.rec
+        tracing = rec is not None and rec.enabled
+        t0 = trace_now() if tracing else 0.0
         try:
             sig = self.cons.unmarshal_signature(packet.multisig)
         except Exception:
             return
+        if tracing:
+            if packet.sent_ts and packet.sent_ts <= t0:
+                rec.span(
+                    "net_transit",
+                    packet.sent_ts,
+                    t0,
+                    tid=self._tid,
+                    cat="net",
+                    args={
+                        "origin": packet.origin,
+                        "level": packet.level,
+                        "span": packet.span_id,
+                    },
+                )
+            t1 = trace_now()
+            rec.span(
+                "recv",
+                t0,
+                t1,
+                tid=self._tid,
+                cat="pipeline",
+                args={
+                    "origin": packet.origin,
+                    "level": packet.level,
+                    "rts": int(t0 * 1e6),
+                    "span": packet.span_id,
+                },
+            )
+            if packet.span_id:
+                rec.flow("contrib", packet.span_id, "t", t1, tid=self._tid)
         if self.verify_incoming:
             pk = self.reg.identity(packet.origin).public_key
             self.sigs_checked += 1
-            if not pk.verify(self.msg, sig):
+            tv = trace_now() if tracing else 0.0
+            ok = pk.verify(self.msg, sig)
+            if tracing:
+                rec.span(
+                    "verify",
+                    tv,
+                    trace_now(),
+                    tid=self._tid,
+                    cat="pipeline",
+                    args={
+                        "origin": packet.origin,
+                        "level": packet.level,
+                        "rts": int(t0 * 1e6),
+                        "ok": ok,
+                        "span": packet.span_id,
+                    },
+                )
+            if not ok:
                 return
+        if tracing:
+            tm = trace_now()
+            self.sigs[packet.origin] = sig
+            self._maybe_finish()
+            tm2 = trace_now()
+            rec.span(
+                "merge",
+                tm,
+                tm2,
+                tid=self._tid,
+                cat="pipeline",
+                args={
+                    "origin": packet.origin,
+                    "level": packet.level,
+                    "rts": int(t0 * 1e6),
+                    "span": packet.span_id,
+                },
+            )
+            if packet.span_id:
+                rec.flow("contrib", packet.span_id, "f", tm2, tid=self._tid)
+            return
         self.sigs[packet.origin] = sig
         self._maybe_finish()
 
@@ -104,6 +189,13 @@ class GossipAggregator:
             ):
                 return  # poisoned set; keep gossiping (binary search is the
                 # reference's TODO at aggregator.go:206 — same behavior)
+        if self.rec is not None:
+            self.rec.instant(
+                "threshold_reached",
+                tid=self._tid,
+                cat="protocol",
+                args={"card": bs.cardinality(), "threshold": self.threshold},
+            )
         self.final.set_result(ms)
 
     # -- gossip loop --------------------------------------------------------
@@ -131,15 +223,43 @@ class GossipAggregator:
         # gossips until the simulation stops it); `stop()` cancels the task
         while True:
             # diffuse every known individual signature (aggregator.go Diffuse)
+            rec = self.rec
+            tracing = rec is not None and rec.enabled
             for origin, sig in list(self.sigs.items()):
+                if tracing:
+                    self._span_seq += 1
+                    sid = (self.id << 40) | self._span_seq
+                    t0 = trace_now()
+                else:
+                    sid = 0
+                peers = self._peers()
                 self.net.send(
-                    self._peers(),
+                    peers,
                     Packet(
                         origin=origin,
                         level=GOSSIP_LEVEL,
                         multisig=sig.marshal(),
+                        sent_ts=trace_now(),
+                        span_id=sid,
+                        # forwarding another node's signature is a hop
+                        hop=1 if sid and origin != self.id else 0,
                     ),
                 )
+                if tracing:
+                    rec.span(
+                        "send",
+                        t0,
+                        trace_now(),
+                        tid=self._tid,
+                        cat="pipeline",
+                        args={
+                            "level": GOSSIP_LEVEL,
+                            "card": 1,
+                            "peers": len(peers),
+                            "span": sid,
+                        },
+                    )
+                    rec.flow("contrib", sid, "s", t0, tid=self._tid)
             self._maybe_finish()
             await asyncio.sleep(self.period)
 

@@ -198,6 +198,12 @@ class Handel:
         self._tid = identity.id
         if self.rec is not None:
             self.rec.name_thread(self._tid, f"node-{identity.id}")
+        # outbound flow-link ids: (node id << 40) | seq is unique fleet-wide
+        # without coordination; generated only while tracing, so untraced
+        # packets stay span_id=0 (no trailer on the wire)
+        self._span_seq = 0
+        # session tag folded into span args end to end (multi-tenant runs)
+        self._sargs = {"session": self.c.session} if self.c.session else {}
         # distributional measures (always on — a handful of clock reads per
         # level/batch): level-completion latency since start, for the
         # monitor plane's _p50/_p90/_p99 columns (sim/monitor.py)
@@ -338,11 +344,18 @@ class Handel:
                     t0,
                     tid=self._tid,
                     cat="net",
-                    args={"origin": p.origin, "level": p.level},
+                    args={
+                        "origin": p.origin,
+                        "level": p.level,
+                        "span": p.span_id,
+                        **self._sargs,
+                    },
                 )
             ms.recv_ts = t0
+            ms.span_id = p.span_id
             if ind is not None:
                 ind.recv_ts = t0
+                ind.span_id = p.span_id
         if not self.levels[p.level].rcv_completed:
             self.proc.add(ms)
             if ind is not None:
@@ -351,18 +364,26 @@ class Handel:
                 # `rts` (arrival stamp, µs) discriminates re-deliveries of
                 # the same (origin, level) so the trace CLI reconstructs
                 # each physical contribution's chain separately
+                t1 = trace_now()
                 rec.span(
                     "recv",
                     t0,
-                    trace_now(),
+                    t1,
                     tid=self._tid,
                     cat="pipeline",
                     args={
                         "origin": p.origin,
                         "level": p.level,
                         "rts": int(t0 * 1e6),
+                        "span": p.span_id,
+                        "hop": p.hop,
+                        **self._sargs,
                     },
                 )
+                if p.span_id:
+                    # flow step: binds the sender's `send` arrow into this
+                    # recv span ("t" + bp:e attaches to the enclosing slice)
+                    rec.flow("contrib", p.span_id, "t", t1, tid=self._tid)
 
     def _warn_once(self, key: str, detail) -> None:
         """WARN on the first occurrence per reason, debug + counter after —
@@ -426,10 +447,11 @@ class Handel:
             self.store.store(sp)
             self._check_completed_level(sp)
             self._check_final_signature(sp)
+            t1 = trace_now()
             rec.span(
                 "merge",
                 t0,
-                trace_now(),
+                t1,
                 tid=self._tid,
                 cat="pipeline",
                 args={
@@ -437,8 +459,15 @@ class Handel:
                     "level": sp.level,
                     "rts": int(sp.recv_ts * 1e6),
                     "ind": sp.is_ind,
+                    "span": sp.span_id,
+                    **self._sargs,
                 },
             )
+            if sp.span_id:
+                # flow finish: the inbound contribution's causal chain ends
+                # where it lands in the store (fast-path sends that happened
+                # inside this merge already opened their own outbound flows)
+                rec.flow("contrib", sp.span_id, "f", t1, tid=self._tid)
             return
         self.store.store(sp)
         self._check_completed_level(sp)
@@ -460,11 +489,25 @@ class Handel:
             return
         if self.done:
             return
+        first = self.best is None
         self.best = sig
         self.log.info(
             "new_sig",
             f"{sig.cardinality()}/{self.threshold}/{self.reg.size()}",
         )
+        if first and self.rec is not None:
+            # the critical-path walk (sim/trace_cli.py) anchors on the
+            # earliest of these across the fleet's node files
+            self.rec.instant(
+                "threshold_reached",
+                tid=self._tid,
+                cat="protocol",
+                args={
+                    "card": sig.cardinality(),
+                    "threshold": self.threshold,
+                    **self._sargs,
+                },
+            )
         self.final_signatures.put_nowait(sig)
 
     def _check_completed_level(self, sp: IncomingSig) -> None:
@@ -527,6 +570,14 @@ class Handel:
         if not ids:
             return
         self.msg_sent_ct += len(ids)
+        rec = self.rec
+        tracing = rec is not None and rec.enabled
+        if tracing:
+            self._span_seq += 1
+            sid = (self.id.id << 40) | self._span_seq
+            t0 = trace_now()
+        else:
+            sid = 0
         p = Packet(
             origin=self.id.id,
             level=level,
@@ -535,8 +586,29 @@ class Handel:
             # always stamped (one clock read per send): a traced RECEIVER
             # can line up cross-node transit spans even when we don't trace
             sent_ts=trace_now(),
+            span_id=sid,
+            # an aggregate of >1 contributions carries earlier hops
+            hop=1 if sid and ms.cardinality() > 1 else 0,
         )
         self.net.send(ids, p)
+        if tracing:
+            t1 = trace_now()
+            rec.span(
+                "send",
+                t0,
+                t1,
+                tid=self._tid,
+                cat="pipeline",
+                args={
+                    "level": level,
+                    "card": ms.cardinality(),
+                    "peers": len(ids),
+                    "span": sid,
+                    **self._sargs,
+                },
+            )
+            # flow start: receivers' recv/merge steps bind to this span
+            rec.flow("contrib", sid, "s", t0, tid=self._tid)
 
     # -- reporting ---------------------------------------------------------
 

@@ -26,6 +26,15 @@ class Packet:
     trace clock). Processes on one host share the clock, so a receiving
     node's flight recorder can emit the network-transit span of every
     contribution; 0.0 means "not stamped".
+
+    `span_id`/`hop` are the compact trace context beside the stamp: the
+    sender's flow-event id linking its `send` span to the receiver's
+    pipeline chain (core/trace.py flow events), and a flag marking the
+    multisig as an aggregate that itself rode earlier hops. They travel as
+    an OPTIONAL 9-byte trailer after the payloads — a packet without one
+    (or with a truncated/corrupt one) decodes as "unlinked" (`span_id=0,
+    hop=0`), never as an error: trace context must not create a new way
+    for a byzantine peer to make packets unparseable.
     """
 
     origin: int  # global id of the sender
@@ -33,19 +42,28 @@ class Packet:
     multisig: bytes  # marshaled MultiSignature
     individual_sig: bytes | None = None  # optional marshaled individual sig
     sent_ts: float = 0.0  # sender trace-clock timestamp (0 = unstamped)
+    span_id: int = 0  # sender flow-link id (0 = unlinked)
+    hop: int = 0  # 1 = aggregate carries earlier hops' contributions
 
     # origin, level, len(multisig), len(indiv), sent_ts
     _HDR = struct.Struct(">iBHHd")
+    # optional trace-context trailer: span id, hop flag
+    _TRAILER = struct.Struct(">QB")
 
     def encode(self) -> bytes:
         ind = self.individual_sig or b""
-        return (
+        wire = (
             self._HDR.pack(
                 self.origin, self.level, len(self.multisig), len(ind), self.sent_ts
             )
             + self.multisig
             + ind
         )
+        if self.span_id or self.hop:
+            wire += self._TRAILER.pack(
+                self.span_id & 0xFFFFFFFFFFFFFFFF, 1 if self.hop else 0
+            )
+        return wire
 
     @classmethod
     def decode(cls, data: bytes) -> "Packet":
@@ -59,12 +77,24 @@ class Packet:
         ind = data[off + ms_len : off + ms_len + ind_len] if ind_len else None
         if not math.isfinite(sent_ts) or sent_ts < 0.0:
             sent_ts = 0.0  # corrupt stamps degrade to "unstamped", never NaN
+        # optional trace-context trailer: anything shorter than the full 9
+        # bytes (stripped, truncated mid-flight, pre-trailer sender) is
+        # simply an unlinked packet — degrade, never raise
+        span_id = hop = 0
+        rest = len(data) - off - ms_len - ind_len
+        if rest >= cls._TRAILER.size:
+            span_id, hop_byte = cls._TRAILER.unpack_from(
+                data, off + ms_len + ind_len
+            )
+            hop = 1 if hop_byte else 0
         return cls(
             origin=origin,
             level=level,
             multisig=ms,
             individual_sig=ind,
             sent_ts=sent_ts,
+            span_id=span_id,
+            hop=hop,
         )
 
 

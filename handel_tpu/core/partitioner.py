@@ -47,6 +47,9 @@ class IncomingSig:
     # into the pending queue — the span boundaries of recv/queue/verify
     recv_ts: float = 0.0
     enqueue_ts: float = 0.0
+    # sender flow-link id (Packet.span_id): rides through queue/verify/merge
+    # span args so the causal chain survives the pending-queue reorder
+    span_id: int = 0
 
     @property
     def individual(self) -> bool:

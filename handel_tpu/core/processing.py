@@ -436,6 +436,12 @@ class BatchProcessing:
                             "rts": int(sp.recv_ts * 1e6),
                             "ind": sp.is_ind,
                             "tries": sp.verify_tries,
+                            "span": sp.span_id,
+                            **(
+                                {"session": self.session}
+                                if self.session
+                                else {}
+                            ),
                         },
                     )
         # Dedup pass: a candidate whose exact content — (level, bitset words,
@@ -524,8 +530,18 @@ class BatchProcessing:
                         "ind": sp.is_ind,
                         "ok": bool(ok) if ok is not None else None,
                         "batch": len(batch),
+                        "span": sp.span_id,
+                        **(
+                            {"session": self.session}
+                            if self.session
+                            else {}
+                        ),
                     },
                 )
+                if sp.span_id:
+                    # flow step through the verify stage keeps the arrow
+                    # alive across the queue reorder (merge emits the "f")
+                    rec.flow("contrib", sp.span_id, "t", t_verified, tid=self.tid)
 
         for sp, ok in zip(batch, oks):
             if ok is None:

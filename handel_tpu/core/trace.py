@@ -18,6 +18,14 @@ tail the claims are about. Two primitives fix that:
 The trace clock is `time.time()` (epoch seconds): processes on one host
 share it, so cross-node spans line up in one timeline — `Packet.sent_ts`
 (core/net.py) carries it across the wire for network-transit spans.
+
+Causal links (ISSUE 10): packets also carry an 8-byte span id, and the
+recorder emits Chrome flow events (`ph: "s"/"t"/"f"`, shared `id`) binding a
+sender's `send` span to the receiver's `recv -> queue -> verify -> merge`
+chain — cross-process causality is recorded, not guessed. Multi-host runs
+additionally carry a per-process `clock_offset` (estimated over the sync
+barrier handshake, sim/sync.py) in the export; `merge_traces` applies it so
+node timelines align within the handshake's RTT bound.
 """
 
 from __future__ import annotations
@@ -50,9 +58,12 @@ class FlightRecorder:
         "capacity",
         "pid",
         "dropped",
+        "clock_offset",
         "_buf",
         "_pos",
         "_count",
+        "_pushed",
+        "_t0",
         "_names",
     )
 
@@ -61,9 +72,14 @@ class FlightRecorder:
         self.capacity = max(1, capacity)
         self.pid = pid
         self.dropped = 0
+        # seconds to ADD to this process's timestamps to land on the sync
+        # master's clock (sim/sync.py offset estimation); applied at merge
+        self.clock_offset = 0.0
         self._buf: list = [None] * self.capacity
         self._pos = 0
         self._count = 0
+        self._pushed = 0  # lifetime events (span-emit rate denominator)
+        self._t0 = trace_now()
         self._names: dict[int, str] = {}  # tid -> thread name metadata
 
     # -- recording (the hot path) -------------------------------------------
@@ -80,7 +96,7 @@ class FlightRecorder:
         """Complete event ("X"): [start, end] in trace-clock seconds."""
         if not self.enabled:
             return
-        self._push((name, "X", start, end - start, tid, cat, args))
+        self._push((name, "X", start, end - start, tid, cat, args, 0))
 
     def instant(
         self,
@@ -92,9 +108,31 @@ class FlightRecorder:
     ) -> None:
         if not self.enabled:
             return
-        self._push((name, "i", ts if ts is not None else trace_now(), 0.0, tid, cat, args))
+        self._push((
+            name, "i", ts if ts is not None else trace_now(), 0.0, tid, cat,
+            args, 0,
+        ))
+
+    def flow(
+        self,
+        name: str,
+        fid: int,
+        ph: str,
+        ts: float,
+        tid: int = 0,
+        cat: str = "flow",
+    ) -> None:
+        """Flow event (`ph` in "s"/"t"/"f") carrying the causal link id
+        `fid` — the packet span id (core/net.py). A flow start on the
+        sender's `send` span and a step/finish on the receiver's pipeline
+        spans draw one contribution's cross-process arrow in Perfetto, and
+        the critical-path analyzer (sim/trace_cli.py) walks the same ids."""
+        if not self.enabled:
+            return
+        self._push((name, ph, ts, 0.0, tid, cat, None, fid))
 
     def _push(self, ev: tuple) -> None:
+        self._pushed += 1
         if self._count >= self.capacity:
             self.dropped += 1
         else:
@@ -126,7 +164,7 @@ class FlightRecorder:
                     "args": {"name": name},
                 }
             )
-        for name, ph, ts, dur, tid, cat, args in self.events():
+        for name, ph, ts, dur, tid, cat, args, fid in self.events():
             ev = {
                 "name": name,
                 "ph": ph,
@@ -136,12 +174,22 @@ class FlightRecorder:
             }
             if ph == "X":
                 ev["dur"] = max(0.0, dur) * 1e6
+            elif ph in ("s", "t", "f"):
+                ev["id"] = fid
+                if ph != "s":
+                    # bind to the enclosing slice, not the next one
+                    ev["bp"] = "e"
             if cat:
                 ev["cat"] = cat
             if args:
                 ev["args"] = args
             out.append(ev)
-        return {"traceEvents": out, "displayTimeUnit": "ms"}
+        return {
+            "traceEvents": out,
+            "displayTimeUnit": "ms",
+            # per-process clock alignment, applied by merge_traces
+            "clockOffset": self.clock_offset,
+        }
 
     def dump(self, path: str) -> str:
         with open(path, "w") as f:
@@ -149,11 +197,20 @@ class FlightRecorder:
         return path
 
     def values(self) -> dict[str, float]:
-        """Reporter-plane counters (core/report.py shape)."""
+        """Reporter-plane counters (core/report.py shape): ring occupancy,
+        silent-truncation count, and the live span-emit rate — the
+        `/metrics` + `sim watch` surface that makes a wrapped ring visible
+        while the run is still going."""
+        dt = trace_now() - self._t0
         return {
             "traceEvents": float(self._count),
             "traceDropped": float(self.dropped),
+            "traceSpanRate": self._pushed / dt if dt > 0 else 0.0,
         }
+
+    def gauge_keys(self) -> set[str]:
+        """Explicit gauge declaration (core/metrics.py is_gauge_key)."""
+        return {"traceSpanRate"}
 
 
 class LogHistogram:
@@ -255,9 +312,18 @@ class LogHistogram:
 
 
 def merge_traces(exports: Iterable[Mapping]) -> dict:
-    """Combine per-process Chrome trace exports into one timeline."""
+    """Combine per-process Chrome trace exports into one timeline.
+
+    Each export's estimated `clockOffset` (seconds, sim/sync.py handshake)
+    is applied here — shifting every event onto the sync master's clock —
+    so multi-host timelines align within the handshake's RTT bound instead
+    of drifting by whatever NTP left behind."""
     events: list = []
     for ex in exports:
-        events.extend(ex.get("traceEvents", []))
+        off_us = float(ex.get("clockOffset", 0.0) or 0.0) * 1e6
+        for e in ex.get("traceEvents", []):
+            if off_us and e.get("ph") != "M":
+                e = {**e, "ts": e.get("ts", 0.0) + off_us}
+            events.append(e)
     events.sort(key=lambda e: (e.get("ts", 0.0), e.get("pid", 0)))
     return {"traceEvents": events, "displayTimeUnit": "ms"}

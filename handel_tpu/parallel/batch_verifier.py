@@ -114,6 +114,12 @@ class BatchVerifierService:
         self.rec = recorder
         if recorder is not None:
             recorder.name_thread(SERVICE_TID, "batch-verifier")
+            # each chip is a named trace thread carrying its launch
+            # lifecycle (queued/staged/on-device/fetched spans below)
+            for lane in self.plane.lanes:
+                recorder.name_thread(
+                    lane.trace_tid, f"device-lane-{lane.index}"
+                )
         self.max_delay = max_delay_ms / 1000.0
         self.max_inflight = max(1, max_inflight)
         # -- resilience plane: per-lane breakers + host failover ------------
@@ -214,7 +220,7 @@ class BatchVerifierService:
             if lane.fetch_q is not None:
                 while True:
                     try:
-                        _, items = lane.fetch_q.get_nowait()
+                        items = lane.fetch_q.get_nowait()[1]
                     except asyncio.QueueEmpty:
                         break
                     fail(items)
@@ -453,8 +459,22 @@ class BatchVerifierService:
                 # no-op). No await between pick and put -> put_nowait is
                 # safe on the capacity-1 cell.
                 lane.dispatching = items
+                if self.rec is not None and self.rec.enabled:
+                    # launch_queued span start (the dispatcher reads it when
+                    # it takes the group off the capacity-1 cell)
+                    lane.queued_ts = trace_now()
                 lane.q.put_nowait(items)
             self._collector_held = None
+
+    def _lane_span_args(self, lane: DeviceLane, items: list) -> dict:
+        """Launch-lifecycle span args: lane, group size, and the sessions
+        whose candidates ride this launch (computed only while tracing —
+        the set build never runs on the untraced hot path)."""
+        args = {"lane": lane.index, "n": len(items)}
+        sessions = sorted({it[_SESSION] for it in items if it[_SESSION]})
+        if sessions:
+            args["sessions"] = ",".join(sessions)
+        return args
 
     async def _lane_dispatcher(self, lane: DeviceLane) -> None:
         """Per-lane first pipeline stage: dispatch groups handed to this
@@ -464,18 +484,32 @@ class BatchVerifierService:
         while True:
             items = await lane.q.get()
             handle = None
+            tracing = self.rec is not None and self.rec.enabled
+            t_deq = trace_now() if tracing else 0.0
+            if tracing and lane.queued_ts:
+                # time the group sat in the hand-off cell waiting for this
+                # lane — the first stage of its lifecycle timeline
+                self.rec.span(
+                    "launch_queued",
+                    lane.queued_ts,
+                    t_deq,
+                    tid=lane.trace_tid,
+                    cat="device",
+                    args=self._lane_span_args(lane, items),
+                )
             if lane.breaker.allow():
                 t0 = trace_now()
                 handle = await self._dispatch_with_retries(
                     lane, self._launch_call(lane, items)
                 )
-                if self.rec is not None and self.rec.enabled:
+                if tracing:
+                    t_disp = trace_now()
                     # the host half of a launch: request packing + the
                     # async enqueue (PR 1's host_pack_ms lives in here)
                     self.rec.span(
                         "dispatch_pack",
                         t0,
-                        trace_now(),
+                        t_disp,
                         tid=SERVICE_TID,
                         cat="verifier",
                         args={
@@ -483,6 +517,15 @@ class BatchVerifierService:
                             "ok": handle is not None,
                             "device": lane.index,
                         },
+                    )
+                    # same interval on the lane's own timeline: host staging
+                    self.rec.span(
+                        "launch_staged",
+                        t0,
+                        t_disp,
+                        tid=lane.trace_tid,
+                        cat="device",
+                        args=self._lane_span_args(lane, items),
                     )
             if handle is None:
                 # this lane's breaker opened (or retries exhausted): the
@@ -502,7 +545,9 @@ class BatchVerifierService:
                 lane.candidates += len(items)
                 if len({it[_MSG] for it in items}) > 1:
                     self.coalesced_launches += 1
-                await lane.fetch_q.put((handle, items))
+                # dispatch-completion stamp rides to the fetcher: the
+                # launch_on_device span starts where staging ended
+                await lane.fetch_q.put((handle, items, trace_now()))
             lane.dispatching = None
             self._free.set()
 
@@ -594,7 +639,7 @@ class BatchVerifierService:
         dispatched launches, in dispatch order, and resolve the waiters."""
         loop = asyncio.get_running_loop()
         while True:
-            handle, items = await lane.fetch_q.get()
+            handle, items, t_disp = await lane.fetch_q.get()
             # outside the window until resolved: visible to stop() (see
             # _collector's mirror note)
             lane.fetching = items
@@ -617,15 +662,35 @@ class BatchVerifierService:
                 lane.fetching = None
                 continue
             if self.rec is not None and self.rec.enabled:
+                t_end = trace_now()
                 # device wall per launch (verdict-arrival latency), the
                 # counterpart of dispatch_pack's host half
                 self.rec.span(
                     "device_verify",
                     t0,
-                    trace_now(),
+                    t_end,
                     tid=SERVICE_TID,
                     cat="verifier",
                     args={"n": len(items), "device": lane.index},
+                )
+                largs = self._lane_span_args(lane, items)
+                # lane-timeline remainder of the lifecycle: in flight on
+                # the chip since dispatch, and the verdict transfer window
+                self.rec.span(
+                    "launch_on_device",
+                    t_disp,
+                    t_end,
+                    tid=lane.trace_tid,
+                    cat="device",
+                    args=largs,
+                )
+                self.rec.span(
+                    "launch_fetched",
+                    t0,
+                    t_end,
+                    tid=lane.trace_tid,
+                    cat="device",
+                    args=largs,
                 )
             lane.breaker.record_success()
             lane.fetched += 1

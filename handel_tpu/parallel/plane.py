@@ -50,7 +50,7 @@ class DeviceLane:
     __slots__ = (
         "index", "engine", "breaker", "q", "fetch_q", "dispatching",
         "fetching", "launches", "candidates", "fill_sum", "last_fill",
-        "retries", "fetched",
+        "retries", "fetched", "queued_ts",
     )
 
     def __init__(self, index: int, engine, breaker: CircuitBreaker | None = None):
@@ -67,6 +67,15 @@ class DeviceLane:
         self.last_fill = 0.0
         self.retries = 0
         self.fetched = 0
+        # trace stamp: when the launch group currently in `q` was handed to
+        # this lane (the launch_queued span's start, batch_verifier.py)
+        self.queued_ts = 0.0
+
+    @property
+    def trace_tid(self) -> int:
+        """Chrome-trace thread id for this lane's launch-lifecycle spans:
+        negative ids keep lanes clear of node tids, below SERVICE_TID."""
+        return -(2 + self.index)
 
     def free(self) -> bool:
         """Can accept a launch group right now (dispatch slot empty)."""

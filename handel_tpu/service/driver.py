@@ -92,6 +92,7 @@ class MultiSessionCluster:
         seed_base: int = 0,
         config_tweak=None,
         devices: int = 1,
+        recorder=None,
     ):
         self.k = sessions
         self.nodes = nodes
@@ -119,12 +120,18 @@ class MultiSessionCluster:
             max_delay_ms=max_delay_ms,
             quantum=quantum,
             max_pending_per_session=max_pending_per_session,
+            recorder=recorder,
         )
+        # one shared ring across every session's nodes AND the verify
+        # plane: session-tagged spans end to end (core/handel.py _sargs,
+        # batch_verifier.py lane lifecycle `sessions` arg)
+        self.recorder = recorder
         self.manager = SessionManager(
             service=self.service,
             scheme=scheme,
             max_sessions=max_sessions or sessions,
             session_ttl_s=session_ttl_s,
+            recorder=recorder,
         )
 
         # live telemetry (core/metrics.py): the shared verifier plane plus
@@ -158,6 +165,9 @@ class MultiSessionCluster:
             reg.add_readiness(
                 "sessions_spawned", lambda: self.manager.spawned_ct > 0
             )
+            if recorder is not None:
+                # ring occupancy / drops / span rate beside the service rows
+                reg.register_values("trace", recorder)
             self.metrics = reg
             self.metrics_server = MetricsServer(reg, port=metrics_port).start()
 

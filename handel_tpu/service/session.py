@@ -74,6 +74,7 @@ class Session:
         ttl_s: float = 60.0,
         clock: Callable[[], float] = time.monotonic,
         config_tweak: Callable[[Config, int], None] | None = None,
+        recorder=None,
     ):
         self.sid = sid
         self.n = n
@@ -100,6 +101,9 @@ class Session:
             # verifier, its share of the fairness queue and the service
             # dedup plane
             cfg.session = sid
+            # shared flight recorder (core/trace.py): every node of every
+            # session records into one ring, spans tagged by session above
+            cfg.recorder = recorder
             cfg.rand = random.Random(seed * 100003 + i)
             if verifier is not None:
                 cfg.verifier = verifier
@@ -245,10 +249,12 @@ class SessionManager:
         clock: Callable[[], float] = time.monotonic,
         scorers: SessionScorers | None = None,
         retired_capacity: int = 4096,
+        recorder=None,
     ):
         if max_sessions < 1:
             raise ValueError("max_sessions must be >= 1")
         self.service = service
+        self.recorder = recorder
         self.scheme = scheme or FakeScheme()
         self.max_sessions = max_sessions
         self.session_ttl_s = session_ttl_s
@@ -314,6 +320,7 @@ class SessionManager:
             ttl_s=self.session_ttl_s if ttl_s is None else ttl_s,
             clock=self.clock,
             config_tweak=config_tweak,
+            recorder=self.recorder,
         )
         self.sessions[sid] = s
         self.spawned_ct += 1

@@ -235,7 +235,16 @@ async def run_node_process(args) -> int:
             agg_cls, kw = (
                 (GossipSubAggregator, {})
                 if cfg.baseline == "gossipsub"
-                else (GossipAggregator, {"connector": "full"})
+                else (
+                    GossipAggregator,
+                    # same recv/verify/merge spans as Handel, so baseline
+                    # traces compare like-for-like in the trace CLI
+                    {
+                        "connector": "full",
+                        "recorder": recorder,
+                        "trace_tid": nid,
+                    },
+                )
             )
             h = agg_cls(
                 net,
@@ -321,6 +330,13 @@ async def run_node_process(args) -> int:
     await asyncio.gather(
         *(s.signal_and_wait(STATE_START, cfg.max_timeout_s) for s in slaves)
     )
+    if recorder is not None and slaves:
+        # best (min-RTT) offset-vs-master estimate from the START handshake
+        # (sim/sync.py): carried in the trace export so merge_traces aligns
+        # this process's timeline with the rest of the fleet
+        best_slave = min(slaves, key=lambda s: s.clock_rtt)
+        if best_slave.clock_rtt != float("inf"):
+            recorder.clock_offset = best_slave.clock_offset
 
     measures = []
     for nid, h, net in handels:

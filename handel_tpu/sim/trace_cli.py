@@ -10,11 +10,19 @@ cluster) and answers the questions the CSV cannot:
 - slowest-span attribution: which pipeline stage (recv, queue, verify,
   merge, dispatch_pack, device_verify, net_transit) the wall time went to;
 - per-contribution chains: recv -> queue -> verify -> merge span coverage,
-  surfacing where a contribution stalled.
+  surfacing where a contribution stalled;
+- the CRITICAL PATH to threshold (`--critical-path`): walk the
+  threshold-reaching merge backwards through verify/queue/recv/net_transit
+  and across processes via the packet span ids (ISSUE 10 flow links) to a
+  contributor's first send, with per-stage (net/queue/verify/merge/device)
+  attribution — the causal answer to "why did this run take X ms".
 
 Options: `--merged out.json` writes the combined timeline (open in
 chrome://tracing or Perfetto); `--plot out.png` draws the wave via
-sim/plots.py; `--top N` bounds the attribution table.
+sim/plots.py; `--top N` bounds the attribution table; `--report out.json`
+writes the machine-readable `trace_report.json` (bench-record shaped, so
+scripts/bench_check.py tracks time-to-threshold / coverage / flow linkage /
+lane occupancy as side metrics).
 """
 
 from __future__ import annotations
@@ -30,9 +38,20 @@ from handel_tpu.core.trace import merge_traces
 #: pipeline spans that make up a contribution's recv -> merge chain
 CHAIN_SPANS = ("recv", "queue", "verify", "merge")
 
+#: chain span name -> critical-path attribution stage
+STAGE_OF = {
+    "net_transit": "net",
+    "recv": "recv",
+    "queue": "queue",
+    "verify": "verify",
+    "merge": "merge",
+    "send": "send",
+}
 
-def load_traces(paths: list[str]) -> list[dict]:
-    """Load trace events from files and/or directories of trace_*.json."""
+
+def load_exports(paths: list[str]) -> list[dict]:
+    """Load the raw per-process exports (clockOffset intact) from files
+    and/or directories of trace_*.json."""
     files: list[str] = []
     for p in paths:
         if os.path.isdir(p):
@@ -45,7 +64,12 @@ def load_traces(paths: list[str]) -> list[dict]:
     for f in files:
         with open(f) as fh:
             exports.append(json.load(fh))
-    return merge_traces(exports)["traceEvents"]
+    return exports
+
+
+def load_traces(paths: list[str]) -> list[dict]:
+    """Load trace events from files and/or directories of trace_*.json."""
+    return merge_traces(load_exports(paths))["traceEvents"]
 
 
 def _t0(events: list[dict]) -> float:
@@ -152,6 +176,277 @@ def contribution_chains(events: list[dict]) -> dict[tuple, dict]:
     return out
 
 
+def _interval_union(ivs: list[tuple[float, float]]) -> float:
+    """Total length of the union of [lo, hi) intervals (µs in, µs out)."""
+    covered, cur_lo, cur_hi = 0.0, None, None
+    for lo, hi in sorted(ivs):
+        if hi <= lo:
+            continue
+        if cur_hi is None or lo > cur_hi:
+            if cur_hi is not None:
+                covered += cur_hi - cur_lo
+            cur_lo, cur_hi = lo, hi
+        else:
+            cur_hi = max(cur_hi, hi)
+    if cur_hi is not None:
+        covered += cur_hi - cur_lo
+    return covered
+
+
+def critical_path(events: list[dict]) -> dict | None:
+    """Walk the threshold-reaching aggregate backwards to a contributor's
+    first send — the slowest CAUSAL chain, not a heuristic stitching.
+
+    Anchor: the fleet's earliest `threshold_reached` instant. From the
+    merge span enclosing it, the local pipeline is matched by
+    (pid, tid, origin, level, rts); the cross-process hop resolves the
+    merge's packet span id to the SENDER's `send` span, then recurses into
+    the merge that produced that send (fast-path sends happen inside the
+    producing merge's interval, core/handel.py _check_completed_level).
+    The walk ends at a send with no producing merge — the contribution's
+    origin. Returns None when the trace holds no threshold instant.
+
+    Verify time overlapping the shared service's `device_verify` launches
+    (same process) is re-attributed to the `device` stage, so host-queue
+    wait and chip wall are separated in the stage breakdown.
+    """
+    thresholds = [
+        e for e in events
+        if e.get("ph") == "i" and e.get("name") == "threshold_reached"
+    ]
+    if not thresholds:
+        return None
+    anchor = min(thresholds, key=lambda e: e["ts"])
+
+    merges: dict[tuple, list[dict]] = {}
+    pipeline: dict[tuple, dict[str, list[dict]]] = {}
+    transits: dict[tuple, list[dict]] = {}
+    sends: dict[int, dict] = {}
+    device_ivs: dict[int, list[tuple[float, float]]] = {}
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        name, a = e.get("name"), e.get("args", {})
+        pt = (e.get("pid", 0), e.get("tid", 0))
+        if name == "merge":
+            merges.setdefault(pt, []).append(e)
+        if name in ("merge", "verify", "queue", "recv") and "rts" in a:
+            key = pt + (a.get("origin"), a.get("level"), a["rts"])
+            pipeline.setdefault(key, {}).setdefault(name, []).append(e)
+        elif name == "net_transit":
+            transits.setdefault(
+                pt + (a.get("origin"), a.get("level")), []
+            ).append(e)
+        elif name == "send" and a.get("span"):
+            sends[a["span"]] = e
+        elif name == "device_verify":
+            device_ivs.setdefault(e.get("pid", 0), []).append(
+                (e["ts"], e["ts"] + e.get("dur", 0.0))
+            )
+    for evs in merges.values():
+        evs.sort(key=lambda e: e["ts"] + e.get("dur", 0.0))
+
+    def enclosing_merge(pt: tuple, ts: float) -> dict | None:
+        """The merge containing ts on (pid, tid), else the latest one
+        ending at/before ts (a periodic resend of an earlier merge)."""
+        best = None
+        for m in merges.get(pt, ()):
+            lo, hi = m["ts"], m["ts"] + m.get("dur", 0.0)
+            if lo <= ts <= hi:
+                return m
+            if hi <= ts:
+                best = m  # sorted by end: the last such wins
+        return best
+
+    def pick(evs: list[dict] | None, span: int) -> dict | None:
+        """Prefer the event whose span arg matches; else the longest."""
+        if not evs:
+            return None
+        same = [e for e in evs if e.get("args", {}).get("span") == span]
+        pool = same or evs
+        return max(pool, key=lambda e: e.get("dur", 0.0))
+
+    chain: list[dict] = []
+    visited: set[int] = set()
+    cur = enclosing_merge((anchor.get("pid", 0), anchor.get("tid", 0)),
+                          anchor["ts"])
+    while cur is not None and id(cur) not in visited:
+        visited.add(id(cur))
+        a = cur.get("args", {})
+        pt = (cur.get("pid", 0), cur.get("tid", 0))
+        key = pt + (a.get("origin"), a.get("level"), a.get("rts"))
+        span = a.get("span", 0)
+        hop = [cur]
+        stages = pipeline.get(key, {})
+        for name in ("verify", "queue", "recv"):
+            m = pick(stages.get(name), span)
+            if m is not None:
+                hop.append(m)
+        nt = pick(transits.get(pt + (a.get("origin"), a.get("level"))), span)
+        if nt is not None:
+            hop.append(nt)
+        chain.extend(hop)
+        send = sends.get(span) if span else None
+        if send is None:
+            break
+        chain.append(send)
+        cur = enclosing_merge(
+            (send.get("pid", 0), send.get("tid", 0)), send["ts"]
+        )
+
+    chain.reverse()  # origin-first: contributor's send ... -> final merge
+    start = min(e["ts"] for e in chain) if chain else anchor["ts"]
+    wall = anchor["ts"] - start
+    ivs = [
+        (e["ts"], min(e["ts"] + e.get("dur", 0.0), anchor["ts"]))
+        for e in chain
+    ]
+    stages_us: dict[str, float] = {}
+    for e in chain:
+        stage = STAGE_OF.get(e["name"], e["name"])
+        lo, hi = e["ts"], min(e["ts"] + e.get("dur", 0.0), anchor["ts"])
+        dur = max(0.0, hi - lo)
+        if e["name"] == "verify":
+            # chip wall inside the verify window attributes to `device`
+            on_dev = _interval_union([
+                (max(lo, dlo), min(hi, dhi))
+                for dlo, dhi in device_ivs.get(e.get("pid", 0), ())
+                if dhi > lo and dlo < hi
+            ])
+            stages_us["device"] = stages_us.get("device", 0.0) + on_dev
+            dur -= on_dev
+        stages_us[stage] = stages_us.get(stage, 0.0) + dur
+    return {
+        "anchor": {
+            "pid": anchor.get("pid", 0),
+            "tid": anchor.get("tid", 0),
+            "args": anchor.get("args", {}),
+        },
+        "threshold_ts": anchor["ts"],
+        "start_ts": start,
+        "wall_ms": wall / 1e3,
+        "coverage": _interval_union(ivs) / wall if wall > 0 else 1.0,
+        "hops": sum(1 for e in chain if e["name"] == "send"),
+        "stages_ms": {k: v / 1e3 for k, v in sorted(stages_us.items())},
+        "chain": [
+            {
+                "name": e["name"],
+                "pid": e.get("pid", 0),
+                "tid": e.get("tid", 0),
+                "t_ms": (e["ts"] - start) / 1e3,
+                "dur_ms": e.get("dur", 0.0) / 1e3,
+                "origin": e.get("args", {}).get("origin"),
+                "level": e.get("args", {}).get("level"),
+                "span": e.get("args", {}).get("span"),
+            }
+            for e in chain
+        ],
+    }
+
+
+def flow_linkage(events: list[dict]) -> tuple[float, int, int]:
+    """(linked fraction, linked, total) over recv spans that carry a trace
+    context: a recv is LINKED when its packet span id resolves to a send
+    span somewhere in the merged trace. Unlinked recvs are degraded
+    contexts (span 0) or senders whose dump is missing."""
+    send_ids = {
+        e["args"]["span"]
+        for e in events
+        if e.get("ph") == "X" and e.get("name") == "send"
+        and e.get("args", {}).get("span")
+    }
+    total = linked = 0
+    for e in events:
+        if e.get("ph") != "X" or e.get("name") != "recv":
+            continue
+        a = e.get("args", {})
+        if "span" not in a:
+            continue  # pre-ISSUE-10 trace
+        total += 1
+        if a["span"] and a["span"] in send_ids:
+            linked += 1
+    return (linked / total if total else 0.0), linked, total
+
+
+def lane_occupancy(events: list[dict]) -> dict:
+    """Per device lane: on-device busy fraction over the lane's active
+    window (union of its launch_on_device spans / first-to-last extent),
+    plus the fleet mean — the timeline form of the plane's fill gauges."""
+    by_lane: dict[tuple, list[tuple[float, float]]] = {}
+    for e in events:
+        if e.get("ph") == "X" and e.get("name") == "launch_on_device":
+            by_lane.setdefault(
+                (e.get("pid", 0), e.get("tid", 0)), []
+            ).append((e["ts"], e["ts"] + e.get("dur", 0.0)))
+    lanes = {}
+    for (pid, tid), ivs in sorted(by_lane.items()):
+        window = max(hi for _, hi in ivs) - min(lo for lo, _ in ivs)
+        lanes[f"{pid}/{tid}"] = (
+            _interval_union(ivs) / window if window > 0 else 1.0
+        )
+    mean = sum(lanes.values()) / len(lanes) if lanes else 0.0
+    return {"mean": mean, "lanes": lanes}
+
+
+def build_report(events: list[dict], exports: list[dict] | None = None) -> dict:
+    """The machine-readable `trace_report.json`: bench-record shaped
+    (metric/value/backend, scripts/bench_check.py extract_metrics) with the
+    critical-path breakdown, per-level wave, flow linkage, lane occupancy
+    and the per-process clock offsets as payload."""
+    cp = critical_path(events)
+    linkage, linked, total = flow_linkage(events)
+    occ = lane_occupancy(events)
+    wave = level_timeline(events)
+    offsets = [
+        float(ex.get("clockOffset", 0.0) or 0.0) for ex in exports or []
+    ]
+    tts = cp["wall_ms"] / 1e3 if cp else 0.0
+    report = {
+        "metric": "trace_time_to_threshold_s",
+        "value": tts,
+        "backend": "trace",
+        "time_to_threshold_s": tts,
+        "critical_path_coverage": cp["coverage"] if cp else 0.0,
+        "flow_linkage": linkage,
+        "flow_linked": linked,
+        "flow_total": total,
+        "lane_occupancy": occ["mean"],
+        "lanes": occ["lanes"],
+        "critical_path": cp,
+        "levels_s": {
+            str(lvl): {"first": f, "median": m, "last": l}
+            for lvl, (f, m, l) in wave.items()
+        },
+        "clock_offsets_s": offsets,
+        "events": len(events),
+    }
+    return report
+
+
+def print_critical_path(cp: dict | None) -> None:
+    if cp is None:
+        print("\ncritical path: no threshold_reached instant in trace")
+        return
+    print(
+        f"\ncritical path to threshold: {cp['wall_ms']:.2f} ms over "
+        f"{cp['hops']} hop(s), {cp['coverage']:.1%} span-attributed"
+    )
+    print("  stage breakdown: " + "  ".join(
+        f"{k}={v:.2f}ms" for k, v in cp["stages_ms"].items()
+    ))
+    for e in cp["chain"]:
+        where = f"pid {e['pid']} tid {e['tid']}"
+        tag = (
+            f"origin={e['origin']} level={e['level']}"
+            if e["origin"] is not None
+            else f"level={e['level']}" if e["level"] is not None else ""
+        )
+        print(
+            f"  +{e['t_ms']:9.3f} ms {e['name']:>12} {e['dur_ms']:9.3f} ms"
+            f"  [{where}] {tag}"
+        )
+
+
 def main(argv: list[str]) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m handel_tpu.sim trace",
@@ -161,9 +456,18 @@ def main(argv: list[str]) -> int:
     ap.add_argument("--merged", default="", help="write combined Chrome trace JSON")
     ap.add_argument("--plot", default="", help="write the aggregation-wave PNG")
     ap.add_argument("--top", type=int, default=10, help="attribution rows shown")
+    ap.add_argument(
+        "--critical-path", action="store_true",
+        help="walk + print the causal chain to threshold",
+    )
+    ap.add_argument(
+        "--report", default="",
+        help="write the machine-readable trace_report.json here",
+    )
     args = ap.parse_args(argv)
 
-    events = load_traces(args.paths)
+    exports = load_exports(args.paths)
+    events = merge_traces(exports)["traceEvents"]
     print(f"{len(events)} events loaded")
 
     wave = level_timeline(events)
@@ -200,6 +504,28 @@ def main(argv: list[str]) -> int:
                 f"  node {tid} origin={origin} level={level}: "
                 f"{c['wall_ms']:.2f} ms ({c['coverage']:.0%} attributed) {stages}"
             )
+
+    if args.critical_path:
+        print_critical_path(critical_path(events))
+        linkage, linked, total = flow_linkage(events)
+        occ = lane_occupancy(events)
+        print(
+            f"\nflow linkage: {linked}/{total} recvs resolved to their "
+            f"sender's span ({linkage:.1%})"
+        )
+        if occ["lanes"]:
+            print(
+                "lane occupancy: "
+                + "  ".join(
+                    f"{k}={v:.1%}" for k, v in occ["lanes"].items()
+                )
+                + f"  (mean {occ['mean']:.1%})"
+            )
+
+    if args.report:
+        with open(args.report, "w") as f:
+            json.dump(build_report(events, exports), f, indent=1)
+        print(f"\ntrace report -> {args.report}")
 
     if args.merged:
         with open(args.merged, "w") as f:

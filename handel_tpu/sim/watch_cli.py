@@ -198,6 +198,11 @@ def aggregate(scrapes: list[dict]) -> dict:
         "net_dropped": total("handel_net_dropped_packets"),
         "verifier_launches": total("handel_device_verifier_verifier_launches"),
         "occupancy": mean("handel_device_verifier_verifier_occupancy"),
+        # flight-recorder plane (core/trace.py values()): ring fill, drops
+        # and the spans/s emit rate — the satellite-1 observability row
+        "trace_events": total("handel_trace_trace_events"),
+        "trace_dropped": total("handel_trace_trace_dropped"),
+        "trace_rate": mean("handel_trace_trace_span_rate"),
         "families": len(fams),
     }
 
@@ -357,6 +362,13 @@ def render(model: dict, endpoints: list[str], up: int, tick: int) -> str:
         f"rcvd {_num(model['net_rcvd'])}  "
         f"dropped {_num(model['net_dropped'])}"
     )
+    if model.get("trace_events") is not None:
+        rate = model.get("trace_rate")
+        lines.append(
+            f"tracing  spans {_num(model['trace_events'])}  "
+            f"dropped {_num(model['trace_dropped'])}  "
+            f"rate {('--' if rate is None else f'{rate:,.0f}/s')}"
+        )
     return "\n".join(lines)
 
 

@@ -54,6 +54,14 @@ SIDE_METRICS = {
     "launches_per_s": "higher",
     "fleet_speedup_x": "higher",
     "fleet_fill_ratio": "higher",
+    # causal-tracing plane (sim trace --report / scripts/trace_smoke.py):
+    # wall time from the critical chain's first send to threshold, the
+    # fraction of that wall the chain's spans attribute, cross-process
+    # flow-link resolution rate, and mean device-lane busy fraction
+    "time_to_threshold_s": "lower",
+    "critical_path_coverage": "higher",
+    "flow_linkage": "higher",
+    "lane_occupancy": "higher",
 }
 
 

@@ -1,17 +1,24 @@
-"""Observability CI gate: an 8-node traced LocalCluster smoke run.
+"""Observability CI gate: a 16-node traced LocalCluster smoke run.
 
 Runs a fully traced in-process cluster (fake crypto, seconds on any
 machine), asserts the trace export is non-empty with every pipeline stage
-present and the contribution chains attributable, then prints the trace
-CLI's analysis — so a tracing regression fails CI on its own named step
-(.github/workflows/ci.yml) before the full tier runs.
+present, the contribution chains attributable, the flow links resolvable,
+and — the ISSUE 10 acceptance — that the critical-path walk from the first
+`threshold_reached` instant yields a single causal chain covering >= 90%
+of the wall time-to-threshold with bounded clock offsets. Then prints the
+trace CLI's analysis and writes `trace_report.json`, so a tracing
+regression fails CI on its own named step (.github/workflows/ci.yml)
+before the full tier runs, and the artifact upload step has evidence to
+keep.
 
-Usage: python scripts/trace_smoke.py
+Usage: python scripts/trace_smoke.py [--artifact-dir DIR] [--nodes N]
 """
 
 from __future__ import annotations
 
+import argparse
 import asyncio
+import json
 import os
 import sys
 import tempfile
@@ -19,32 +26,84 @@ import tempfile
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from handel_tpu.core.test_harness import run_cluster  # noqa: E402
-from handel_tpu.core.trace import FlightRecorder  # noqa: E402
+from handel_tpu.core.trace import FlightRecorder, merge_traces  # noqa: E402
 from handel_tpu.sim import trace_cli  # noqa: E402
 
 
-def main() -> int:
-    rec = FlightRecorder(capacity=1 << 16)
-    finals = asyncio.run(run_cluster(8, recorder=rec))
-    assert len(finals) == 8, f"only {len(finals)}/8 nodes reached threshold"
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--artifact-dir", default="",
+        help="keep the trace dump + trace_report.json here (CI upload)",
+    )
+    ap.add_argument("--nodes", type=int, default=16)
+    args = ap.parse_args(argv)
+
+    rec = FlightRecorder(capacity=1 << 17)
+    finals = asyncio.run(run_cluster(args.nodes, recorder=rec))
+    assert len(finals) == args.nodes, (
+        f"only {len(finals)}/{args.nodes} nodes reached threshold"
+    )
 
     events = rec.export()["traceEvents"]
     assert events, "trace export is empty"
     names = {e["name"] for e in events}
-    missing = {"recv", "queue", "verify", "merge", "level_complete"} - names
+    missing = {"recv", "queue", "verify", "merge", "send",
+               "level_complete", "threshold_reached"} - names
     assert not missing, f"missing pipeline spans: {missing}"
 
-    with tempfile.TemporaryDirectory() as d:
+    with tempfile.TemporaryDirectory() as tmp:
+        d = args.artifact_dir or tmp
+        if args.artifact_dir:
+            os.makedirs(d, exist_ok=True)
         rec.dump(os.path.join(d, "trace_0.json"))
-        loaded = trace_cli.load_traces([d])
+        exports = trace_cli.load_exports([d])
+        loaded = merge_traces(exports)["traceEvents"]
+
         chains = trace_cli.contribution_chains(loaded)
         assert chains, "no contribution chains reconstructed"
         best = max(c["coverage"] for c in chains.values())
         assert best >= 0.95, f"best chain coverage {best:.1%} < 95%"
-        trace_cli.main([d, "--top", "5"])
 
-    print(f"\ntrace smoke OK: {len(events)} events, {len(chains)} chains, "
-          f"best coverage {best:.1%}")
+        # ISSUE 10 acceptance: one causal chain, >= 90% of time-to-threshold
+        cp = trace_cli.critical_path(loaded)
+        assert cp is not None, "no threshold_reached anchor in trace"
+        assert cp["chain"], "critical path is empty"
+        assert cp["wall_ms"] > 0, "zero wall time-to-threshold"
+        assert cp["coverage"] >= 0.90, (
+            f"critical path covers {cp['coverage']:.1%} of "
+            f"time-to-threshold < 90%"
+        )
+        assert cp["hops"] >= 1, "critical path crossed no network hop"
+
+        frac, linked, total = trace_cli.flow_linkage(loaded)
+        assert total > 0, "no trace-context-bearing recvs"
+        assert frac >= 0.95, f"flow linkage {frac:.1%} ({linked}/{total})"
+
+        # clock offsets ride each export; in-process they must be ~zero,
+        # and any estimator blow-up (bad sync math) trips this bound
+        offsets = [
+            float(ex.get("clockOffset", 0.0) or 0.0) for ex in exports
+        ]
+        assert all(abs(o) < 1.0 for o in offsets), (
+            f"unbounded clock offsets: {offsets}"
+        )
+
+        report_path = os.path.join(d, "trace_report.json")
+        trace_cli.main([d, "--top", "5", "--critical-path",
+                        "--report", report_path])
+        with open(report_path) as f:
+            report = json.load(f)
+        assert report["backend"] == "trace"
+        assert report["critical_path_coverage"] >= 0.90
+
+    print(
+        f"\ntrace smoke OK: {len(events)} events, {len(chains)} chains, "
+        f"best coverage {best:.1%}; critical path {cp['wall_ms']:.1f} ms "
+        f"over {cp['hops']} hops at {cp['coverage']:.1%} coverage, "
+        f"flow linkage {frac:.1%}"
+        + (f"; artifacts -> {args.artifact_dir}" if args.artifact_dir else "")
+    )
     return 0
 
 

@@ -22,6 +22,33 @@ def test_gossip_random_fanout():
     assert all(ms.bitset.cardinality() >= 6 for ms in results.values())
 
 
+def test_gossip_traced_emits_handel_shaped_spans():
+    """With a recorder attached the baseline emits the SAME pipeline spans,
+    flow links and threshold instant as Handel — so sim trace compares
+    baseline-vs-handel like-for-like (ISSUE 10 satellite)."""
+    from handel_tpu.core.trace import FlightRecorder
+
+    rec = FlightRecorder(capacity=1 << 15)
+    results = asyncio.run(
+        run_gossip(8, threshold=5, connector="full", recorder=rec)
+    )
+    assert len(results) == 8
+    events = rec.export()["traceEvents"]
+    names = {e["name"] for e in events if e["ph"] == "X"}
+    assert {"send", "recv", "verify", "merge", "net_transit"} <= names
+    assert any(
+        e["ph"] == "i" and e["name"] == "threshold_reached" for e in events
+    )
+    # flow links resolve: every traced recv's span id has a send start
+    from handel_tpu.sim import trace_cli
+
+    frac, linked, total = trace_cli.flow_linkage(events)
+    assert total > 0 and frac >= 0.95, f"{linked}/{total}"
+    # gossip lanes are named so merged traces stay readable
+    metas = [e for e in events if e["ph"] == "M"]
+    assert any("gossip-" in str(e["args"].get("name", "")) for e in metas)
+
+
 def test_gossip_aggregate_then_verify_real_crypto():
     from handel_tpu.models.bn254 import BN254Scheme
 

@@ -98,6 +98,57 @@ def test_packet_decode_corrupt_valid_packets():
         assert len(q.multisig) + len(q.individual_sig or b"") <= len(wire)
 
 
+def test_packet_trace_context_roundtrip():
+    """span_id/hop ride an optional wire trailer: present when set,
+    absent (zero overhead) when not, and hop normalizes to 0/1."""
+    rng = random.Random(6)
+    for _ in range(200):
+        p = _random_packet(rng)
+        p.span_id = rng.randrange(2**64) if rng.random() < 0.7 else 0
+        p.hop = rng.randrange(2) if p.span_id else 0
+        wire = p.encode()
+        q = Packet.decode(wire)
+        assert (q.span_id, q.hop) == (p.span_id, p.hop)
+        if not p.span_id and not p.hop:
+            # untraced packets carry no trailer at all
+            assert len(wire) == len(
+                Packet(p.origin, p.level, p.multisig, p.individual_sig).encode()
+            )
+
+
+def test_packet_trace_trailer_truncation_degrades_to_unlinked():
+    """A corrupt or truncated trace trailer must never raise: the packet
+    decodes with span_id=0/hop=0 ("unlinked") as long as the legacy fields
+    are intact — trace context is best-effort metadata, not payload."""
+    rng = random.Random(7)
+    for _ in range(100):
+        p = _random_packet(rng)
+        p.span_id = rng.randrange(1, 2**64)
+        p.hop = 1
+        wire = p.encode()
+        base_len = len(wire) - Packet._TRAILER.size
+        # every partial cut of the trailer -> unlinked, never an error
+        for cut in range(base_len, len(wire)):
+            q = Packet.decode(wire[:cut])
+            assert (q.span_id, q.hop) == (0, 0)
+            assert (q.origin, q.level, q.multisig) == (
+                p.origin, p.level, p.multisig)
+
+
+def test_packet_trace_trailer_hop_normalized():
+    """Arbitrary trailing hop bytes (byzantine sender) normalize to 0/1."""
+    rng = random.Random(8)
+    for _ in range(100):
+        p = _random_packet(rng)
+        base = p.encode()
+        trailer = Packet._TRAILER.pack(
+            rng.randrange(2**64), rng.randrange(256))
+        q = Packet.decode(base + trailer)
+        assert q.hop in (0, 1)
+        assert q.span_id >= 0
+        q.encode()  # re-encode of whatever decoded must not raise
+
+
 def test_multisig_unmarshal_fuzz():
     cons = FakeConstructor()
     rng = random.Random(5)

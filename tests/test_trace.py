@@ -77,6 +77,89 @@ def test_merge_traces_sorts_by_ts():
     assert names == ["y", "x"]
 
 
+def test_flow_events_export_chrome_shape():
+    """Flow links (ph s/t/f) share an id and bind to the enclosing slice
+    (bp: "e" on t/f) — the Chrome/Perfetto contract that draws one arrow
+    per contribution across process rows."""
+    rec = FlightRecorder(capacity=16, pid=3)
+    rec.span("send", 1.0, 1.001, tid=1, cat="pipeline")
+    rec.flow("contrib", 0xBEEF, "s", 1.0, tid=1)
+    rec.span("recv", 1.002, 1.003, tid=2, cat="pipeline")
+    rec.flow("contrib", 0xBEEF, "t", 1.003, tid=2)
+    rec.flow("contrib", 0xBEEF, "f", 1.004, tid=2)
+    ex = rec.export()
+    flows = [e for e in ex["traceEvents"] if e["ph"] in ("s", "t", "f")]
+    assert [e["ph"] for e in flows] == ["s", "t", "f"]
+    assert all(e["id"] == 0xBEEF for e in flows)
+    assert all(e["name"] == "contrib" for e in flows)
+    assert "bp" not in flows[0]
+    assert flows[1]["bp"] == "e" and flows[2]["bp"] == "e"
+    json.dumps(ex)
+
+
+def test_flow_disabled_is_noop():
+    rec = FlightRecorder(capacity=8, enabled=False)
+    rec.flow("contrib", 7, "s", 1.0)
+    assert rec.events() == []
+
+
+def test_merge_traces_applies_clock_offset():
+    """A per-process clockOffset (seconds to add to land on the master's
+    clock) shifts every non-metadata event at merge, so cross-process
+    arrows point forward in time."""
+    a = FlightRecorder(pid=1)
+    b = FlightRecorder(pid=2)
+    a.name_thread(0, "a")
+    a.span("send", 1.0, 1.001, tid=0)
+    b.span("recv", 1.0, 1.002, tid=0)
+    b.clock_offset = 0.5  # b's clock runs half a second behind the master
+    merged = merge_traces([a.export(), b.export()])
+    spans = {
+        (e["pid"], e["name"]): e["ts"]
+        for e in merged["traceEvents"]
+        if e["ph"] == "X"
+    }
+    assert spans[(1, "send")] == pytest.approx(1.0e6)
+    assert spans[(2, "recv")] == pytest.approx(1.5e6)
+    # metadata rows are clock-independent and must not shift
+    meta = [e for e in merged["traceEvents"] if e["ph"] == "M"]
+    assert all(e.get("ts", 0) == 0 for e in meta)
+
+
+def test_span_rate_gauge_in_values():
+    rec = FlightRecorder(capacity=64)
+    for i in range(10):
+        rec.span("s", float(i), float(i) + 0.1)
+    vals = rec.values()
+    assert vals["traceEvents"] == 10.0
+    assert vals["traceSpanRate"] > 0.0
+    assert "traceSpanRate" in rec.gauge_keys()
+
+
+def test_sync_slave_offset_sample_keeps_min_rtt():
+    """The NTP-style estimator keeps the minimum-RTT sample (tightest
+    ±rtt/2 error bound) and rejects backwards clocks."""
+    from handel_tpu.sim.sync import SyncSlave
+
+    s = SyncSlave("127.0.0.1:0", 1)
+    now = time.time()
+    s._offset_sample(now - 0.010, now - 0.005 + 0.3)  # rtt ~10ms, offset ~.3
+    assert s.clock_rtt == pytest.approx(0.010, abs=0.005)
+    first = s.clock_offset
+    assert first == pytest.approx(0.3, abs=0.01)
+    # a noisier (larger-rtt) sample must not displace the kept one
+    s._offset_sample(now - 0.200, now + 1.0)
+    assert s.clock_offset == first
+    # a tighter sample wins
+    s.clock_rtt = 1.0
+    s._offset_sample(time.time() - 1e-4, time.time() + 0.25)
+    assert s.clock_offset == pytest.approx(0.25, abs=0.01)
+    # negative rtt (clock stepped back) is discarded
+    before = s.clock_offset, s.clock_rtt
+    s._offset_sample(time.time() + 5.0, 0.0)
+    assert (s.clock_offset, s.clock_rtt) == before
+
+
 # -- wire transport of the cross-node stamp ----------------------------------
 
 
@@ -150,17 +233,84 @@ def test_level_timeline_is_monotonic(traced_run):
     assert firsts == sorted(firsts)
 
 
+def test_traced_cluster_flow_linkage(traced_run):
+    """Every traced contribution's recv resolves its packet span id back to
+    a send span — in-process, linkage must be total."""
+    _, _, d = traced_run
+    events = trace_cli.load_traces([d])
+    frac, linked, total = trace_cli.flow_linkage(events)
+    assert total > 0
+    assert frac >= 0.95, f"flow linkage {frac:.1%} ({linked}/{total})"
+
+
+def test_critical_path_covers_time_to_threshold(traced_run):
+    """Acceptance: the backwards walk from the first threshold_reached
+    instant yields ONE causal chain whose spans cover >= 90% of the wall
+    time-to-threshold, with per-stage attribution."""
+    _, _, d = traced_run
+    events = trace_cli.load_traces([d])
+    cp = trace_cli.critical_path(events)
+    assert cp is not None, "no threshold_reached anchor"
+    assert cp["chain"], "empty causal chain"
+    assert cp["wall_ms"] > 0
+    assert cp["coverage"] >= 0.90, f"coverage {cp['coverage']:.1%}"
+    names = {e["name"] for e in cp["chain"]}
+    # the chain decomposes into the pipeline stages, net hops included
+    assert {"recv", "verify", "merge", "net_transit"} <= names
+    assert cp["hops"] >= 1
+    # stage attribution is sane: non-negative, and no stage alone exceeds
+    # the wall (adjacent chain spans may overlap, so the SUM can slightly)
+    assert all(v >= 0.0 for v in cp["stages_ms"].values())
+    assert max(cp["stages_ms"].values()) <= cp["wall_ms"] * 1.001
+    # the chain is causally ordered: event starts never move backwards
+    starts = [e["t_ms"] for e in cp["chain"]]
+    assert starts == sorted(starts)
+
+
+def test_build_report_is_bench_record(traced_run):
+    """trace_report.json rides the bench_check gate: record-shaped
+    (metric/value/backend) with every side metric extractable."""
+    import sys
+
+    _, _, d = traced_run
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+    try:
+        import bench_check
+    finally:
+        sys.path.pop(0)
+    exports = trace_cli.load_exports([d])
+    events = merge_traces(exports)["traceEvents"]
+    report = trace_cli.build_report(events, exports)
+    assert report["backend"] == "trace"
+    assert report["metric"] == "trace_time_to_threshold_s"
+    assert report["value"] > 0
+    got = bench_check.extract_metrics(report)
+    for key in ("time_to_threshold_s", "critical_path_coverage",
+                "flow_linkage", "lane_occupancy"):
+        assert (key, "trace") in got, f"{key} not extracted by bench_check"
+    assert got[("critical_path_coverage", "trace")] >= 0.90
+    json.dumps(report)
+
+
 def test_trace_cli_smoke(traced_run, tmp_path, capsys):
     _, _, d = traced_run
     merged = str(tmp_path / "merged.json")
-    assert trace_cli.main([d, "--merged", merged, "--top", "3"]) == 0
+    report = str(tmp_path / "trace_report.json")
+    assert trace_cli.main(
+        [d, "--merged", merged, "--top", "3",
+         "--critical-path", "--report", report]
+    ) == 0
     out = capsys.readouterr().out
     assert "aggregation wave" in out
     assert "slowest-span attribution" in out
     assert "contribution chains" in out
+    assert "critical path to threshold" in out
     with open(merged) as f:
         data = json.load(f)
     assert len(data["traceEvents"]) > 0
+    with open(report) as f:
+        rep = json.load(f)
+    assert rep["backend"] == "trace" and rep["critical_path"]["chain"]
 
 
 def test_trace_cli_plot(traced_run, tmp_path):
@@ -224,12 +374,26 @@ def test_localhost_platform_traced_run(tmp_path):
     # one dump per node process, each a valid non-empty Chrome trace
     dumps = sorted(os.listdir(res.trace_dir))
     assert len(dumps) == 2
-    events = trace_cli.load_traces([res.trace_dir])
+    exports = trace_cli.load_exports([res.trace_dir])
+    events = merge_traces(exports)["traceEvents"]
     assert len(events) > 0
     assert trace_cli.level_timeline(events)  # the wave is reconstructable
     chains = trace_cli.contribution_chains(events)
     assert chains
     assert max(c["coverage"] for c in chains.values()) >= 0.95
+    # cross-process causality (acceptance): >= 95% of traced recvs resolve
+    # their packet span id to the sending process's send span
+    frac, linked, total = trace_cli.flow_linkage(events)
+    assert total > 0
+    assert frac >= 0.95, f"cross-process flow linkage {frac:.1%} ({linked}/{total})"
+    # each process dump carries a clock-offset estimate from the sync
+    # handshake; on one host the skew must be tiny (well under a second)
+    offsets = [float(ex.get("clockOffset", 0.0) or 0.0) for ex in exports]
+    assert len(offsets) == 2
+    assert all(abs(o) < 1.0 for o in offsets), f"clock offsets {offsets}"
+    # the merged trace yields a critical path across processes
+    cp = trace_cli.critical_path(events)
+    assert cp is not None and cp["chain"]
     # distribution columns next to the classic stats
     rows = list(csv.DictReader(open(res.csv_path)))
     for key in ("levelCompleteS", "verifyLatencyS", "queueWaitS"):
