@@ -448,6 +448,58 @@ def fleet_bench(
     }
 
 
+def swarm_bench(
+    identities: int = 512,
+    batch_size: int = 64,
+    timeout_s: float = 120.0,
+) -> dict:
+    """Virtual-node swarm runtime (ROADMAP swarm item): one SwarmHost
+    multiplexing `identities` Handel instances as vnodes on a single event
+    loop — the in-process form of the `sim swarm` capture
+    (results/swarm_65536_summary.json). Reports the committee size carried,
+    summed-RSS bytes per identity (the 1M-identity extrapolation basis),
+    and the wall until the LAST member held a threshold signature. Returns
+    {} unless every vnode finished — a partial swarm must not publish a
+    flattering memory figure.
+    """
+    import asyncio
+
+    from handel_tpu.swarm.driver import SwarmHost, merge_summaries
+
+    async def go():
+        host = SwarmHost(identities, 0, identities, batch_size=batch_size)
+        return await host.run(timeout_s)
+
+    m = merge_summaries([asyncio.run(go())])
+    if not m["ok"]:
+        print(
+            f"bench: swarm bench completed {m['completed']}/{identities} "
+            "vnodes",
+            file=sys.stderr,
+        )
+        return {}
+    return {
+        "swarm_identities": m["swarm_identities"],
+        "mem_bytes_per_identity": m["mem_bytes_per_identity"],
+        "swarm_time_to_threshold_s": m["swarm_time_to_threshold_s"],
+    }
+
+
+def _swarm_metrics() -> dict:
+    """swarm_bench behind the degrade-don't-die contract (+ a shape
+    override for tests: HANDEL_TPU_BENCH_SWARM_SHAPE =
+    'identities,batch')."""
+    shape = os.environ.get("HANDEL_TPU_BENCH_SWARM_SHAPE")
+    try:
+        if shape:
+            identities, batch = (int(x) for x in shape.split(","))
+            return swarm_bench(identities, batch)
+        return swarm_bench()
+    except Exception as e:
+        print(f"bench: swarm bench failed: {e}", file=sys.stderr)
+        return {}
+
+
 def _fleet_metrics() -> dict:
     """fleet_bench behind the degrade-don't-die contract (+ a shape
     override for tests: HANDEL_TPU_BENCH_FLEET_SHAPE =
@@ -827,6 +879,8 @@ def _measure() -> None:
         line.update(_service_metrics())
         # fleet plane: K-lane DevicePlane scheduler throughput vs 1 lane
         line.update(_fleet_metrics())
+        # vnode swarm: identities carried + bytes/identity + completion wall
+        line.update(_swarm_metrics())
 
         def persist(extra_line: dict) -> None:
             # provenance so a later tunnel outage can't erase the capture
@@ -892,6 +946,7 @@ def _measure() -> None:
         line.update(_host_metrics())
         line.update(_service_metrics())
         line.update(_fleet_metrics())
+        line.update(_swarm_metrics())
         _emit(line)
 
 

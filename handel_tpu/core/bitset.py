@@ -10,6 +10,17 @@ TPU-first design: the backing store is a little-endian array of uint64 words
 masks for batched pairing / segment-sum work without a per-bit Python loop
 (SURVEY.md §2.1 "packed uint32[] device representation used as pairing-batch
 masks").
+
+Wire format (ISSUE 11): the reference's uint16-length dense form caps the
+bit-length at 0xFFFE and costs ceil(n/8) bytes regardless of population — a
+level-15 update in a 65k committee would ship 4 KiB of mostly-zero bytes and
+a registry-sized bitset would not fit the header at all. The length value
+0xFFFF is reclaimed as an ESCAPE marker introducing an extended header
+(mode byte + uint32 bit-length) with two payload modes: dense bytes, or a
+varint-delta index list (run-length/index form) chosen whenever it is the
+smaller encoding. Legacy decoders never saw 0xFFFF on the wire (the old
+marshal refused n > 0xFFFF), so the escape is backward-compatible; decode
+caps the declared bit-length so a hostile header cannot allocate gigabytes.
 """
 
 from __future__ import annotations
@@ -17,6 +28,43 @@ from __future__ import annotations
 import struct
 
 import numpy as np
+
+# extended-header caps: enough for >1M-identity registries while bounding a
+# hostile header's allocation to 512 KiB of words (memory-bomb defense)
+MAX_WIRE_BITS = 1 << 22
+_ESCAPE = 0xFFFF
+_MODE_DENSE = 0
+_MODE_SPARSE = 1
+_WORD_ALL = np.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+def _varint(value: int) -> bytes:
+    """Unsigned LEB128."""
+    out = bytearray()
+    while True:
+        b = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _read_varint(data: bytes, pos: int) -> tuple[int, int]:
+    """(value, next position); ValueError on truncation/oversize."""
+    value = shift = 0
+    while True:
+        if pos >= len(data):
+            raise ValueError("bitset varint truncated")
+        b = data[pos]
+        pos += 1
+        value |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return value, pos
+        shift += 7
+        if shift > 28:  # 5 bytes bound any index < MAX_WIRE_BITS
+            raise ValueError("bitset varint overlong")
 
 
 class BitSet:
@@ -27,7 +75,7 @@ class BitSet:
     layout device code wants.
     """
 
-    __slots__ = ("_n", "_words")
+    __slots__ = ("_n", "_words", "_card")
 
     def __init__(self, length: int, _words: np.ndarray | None = None):
         if length < 0:
@@ -39,6 +87,10 @@ class BitSet:
             self._words = _words
         else:
             self._words = np.zeros(nwords, dtype=np.uint64)
+        # popcount cache: the store's evaluate/merge plane reads cardinality
+        # many times between mutations, and the numpy reduction dominated
+        # swarm profiles before caching. Mutators invalidate.
+        self._card: int | None = None
 
     # -- basic ops ---------------------------------------------------------
 
@@ -53,6 +105,7 @@ class BitSet:
             self._words[w] |= np.uint64(1 << b)
         else:
             self._words[w] &= np.uint64(~(1 << b) & 0xFFFFFFFFFFFFFFFF)
+        self._card = None
 
     def get(self, idx: int) -> bool:
         if not 0 <= idx < self._n:
@@ -61,7 +114,9 @@ class BitSet:
         return bool((int(self._words[w]) >> b) & 1)
 
     def cardinality(self) -> int:
-        return int(np.bitwise_count(self._words).sum())
+        if self._card is None:
+            self._card = int(np.bitwise_count(self._words).sum())
+        return self._card
 
     def clone(self) -> "BitSet":
         return BitSet(self._n, self._words.copy())
@@ -143,15 +198,83 @@ class BitSet:
         out[:m] = bits[:m]
         return out
 
-    # -- wire format (reference bitset.go:150-177) -------------------------
+    # -- bulk word-level mutation (swarm combine hot path) -----------------
+
+    def set_range(self, lo: int, hi: int) -> None:
+        """Set bits [lo, hi) true with word fills, not a per-bit loop."""
+        if lo < 0 or hi > self._n or lo > hi:
+            raise IndexError(f"range [{lo},{hi}) out of [0,{self._n})")
+        if lo == hi:
+            return
+        self._card = None
+        w0, b0 = divmod(lo, 64)
+        w1, b1 = divmod(hi - 1, 64)
+        if w0 == w1:
+            self._words[w0] |= np.uint64(
+                ((1 << (hi - lo)) - 1) << b0 & 0xFFFFFFFFFFFFFFFF
+            )
+            return
+        self._words[w0] |= np.uint64((~((1 << b0) - 1)) & 0xFFFFFFFFFFFFFFFF)
+        self._words[w0 + 1 : w1] = _WORD_ALL
+        self._words[w1] |= np.uint64(((1 << (b1 + 1)) - 1) & 0xFFFFFFFFFFFFFFFF)
+
+    def or_embed(self, other, offset: int) -> None:
+        """self |= other << offset — the store's cross-level merge primitive.
+
+        One arbitrary-precision-int shift-or instead of a Python loop over
+        set indices: at swarm scale `combined()`/`full_signature()` run on
+        every verified contribution, and per-index embedding of a 32k-bit
+        complete level is exactly the O(N)-per-event cost the virtual-node
+        runtime cannot afford.
+        """
+        olen = len(other)
+        if offset < 0 or offset + olen > self._n:
+            raise IndexError(
+                f"embed [{offset},{offset + olen}) out of [0,{self._n})"
+            )
+        if isinstance(other, AllOnesBitSet):
+            self.set_range(offset, offset + olen)
+            return
+        if olen == 0:
+            return
+        ov = int.from_bytes(other._words.tobytes(), "little")
+        if not ov:
+            return
+        sv = int.from_bytes(self._words.tobytes(), "little") | (ov << offset)
+        self._words = np.frombuffer(
+            sv.to_bytes(self._words.size * 8, "little"), dtype=np.uint64
+        ).copy()
+        self._card = None
+
+    # -- wire format (reference bitset.go:150-177 + 0xFFFF escape) ---------
 
     def marshal(self) -> bytes:
-        """uint16 big-endian bit-length || minimal little-endian-bit bytes."""
-        if self._n > 0xFFFF:
+        """Smallest of: legacy dense (uint16 length || LE-bit bytes, n <
+        0xFFFF), extended dense, extended sparse (varint-delta indices)."""
+        if self._n > MAX_WIRE_BITS:
             raise ValueError("bitset too large for wire format")
         nbytes = (self._n + 7) // 8
+        dense_total = (2 if self._n < _ESCAPE else 7) + nbytes
+        card = self.cardinality()
+        sparse = None
+        # only pay the O(population) index walk when sparse can win: every
+        # index costs >= 1 payload byte after the 7+ byte extended header
+        if card + 8 < dense_total:
+            payload = bytearray(_varint(card))
+            prev = -1
+            for i in self.indices():
+                payload += _varint(i - prev - 1)  # gap to the previous bit
+                prev = i
+            if 7 + len(payload) < dense_total:
+                sparse = bytes(payload)
+        if sparse is not None:
+            return (
+                struct.pack(">HBI", _ESCAPE, _MODE_SPARSE, self._n) + sparse
+            )
         payload = self._words.view(np.uint8).tobytes()[:nbytes]
-        return struct.pack(">H", self._n) + payload
+        if self._n < _ESCAPE:
+            return struct.pack(">H", self._n) + payload
+        return struct.pack(">HBI", _ESCAPE, _MODE_DENSE, self._n) + payload
 
     @classmethod
     def unmarshal(cls, data: bytes) -> tuple["BitSet", int]:
@@ -159,20 +282,57 @@ class BitSet:
         if len(data) < 2:
             raise ValueError("bitset wire data too short")
         (n,) = struct.unpack(">H", data[:2])
+        if n == _ESCAPE:
+            return cls._unmarshal_extended(data)
         nbytes = (n + 7) // 8
         if len(data) < 2 + nbytes:
             raise ValueError("bitset wire data truncated")
         bs = cls(n)
-        raw = np.frombuffer(data[2 : 2 + nbytes], dtype=np.uint8)
-        padded = np.zeros(((n + 63) // 64) * 8, dtype=np.uint8)
-        padded[: len(raw)] = raw
-        bs._words = padded.view(np.uint64).copy()
-        # zero any bits beyond n that a malicious peer may have set
-        extra = bs._words.size * 64 - n
-        if extra and bs._words.size:
-            keep = np.uint64((1 << (64 - extra)) - 1) if extra < 64 else np.uint64(0)
-            bs._words[-1] &= keep
+        bs._fill_dense(data[2 : 2 + nbytes])
         return bs, 2 + nbytes
+
+    @classmethod
+    def _unmarshal_extended(cls, data: bytes) -> tuple["BitSet", int]:
+        if len(data) < 7:
+            raise ValueError("extended bitset header truncated")
+        _, mode, n = struct.unpack(">HBI", data[:7])
+        if n > MAX_WIRE_BITS:
+            raise ValueError(f"bitset length {n} beyond wire cap")
+        if mode == _MODE_DENSE:
+            nbytes = (n + 7) // 8
+            if len(data) < 7 + nbytes:
+                raise ValueError("bitset wire data truncated")
+            bs = cls(n)
+            bs._fill_dense(data[7 : 7 + nbytes])
+            return bs, 7 + nbytes
+        if mode == _MODE_SPARSE:
+            card, pos = _read_varint(data, 7)
+            if card > n:
+                raise ValueError("sparse bitset population beyond length")
+            bs = cls(n)
+            idx = -1
+            for _ in range(card):
+                gap, pos = _read_varint(data, pos)
+                idx += gap + 1
+                if idx >= n:
+                    raise ValueError("sparse bitset index beyond length")
+                bs._words[idx >> 6] |= np.uint64(1 << (idx & 63))
+            return bs, pos
+        raise ValueError(f"unknown bitset wire mode {mode}")
+
+    def _fill_dense(self, raw_bytes: bytes) -> None:
+        raw = np.frombuffer(raw_bytes, dtype=np.uint8)
+        padded = np.zeros(self._words.size * 8, dtype=np.uint8)
+        padded[: len(raw)] = raw
+        self._words = padded.view(np.uint64).copy()
+        self._card = None
+        # zero any bits beyond n that a malicious peer may have set
+        extra = self._words.size * 64 - self._n
+        if extra and self._words.size:
+            keep = (
+                np.uint64((1 << (64 - extra)) - 1) if extra < 64 else np.uint64(0)
+            )
+            self._words[-1] &= keep
 
     def __repr__(self) -> str:
         return f"BitSet({self._n}, set={self.cardinality()})"
@@ -183,3 +343,77 @@ class BitSet:
             and self._n == other._n
             and bool(np.all(self._words == other._words))
         )
+
+
+class AllOnesBitSet:
+    """Immutable all-set bitset in O(1) memory (a single roaring-style run).
+
+    The windowed store (core/store.py) swaps a completed level's dense best
+    bitset for this when the level retires: a complete level's bitset is by
+    definition the full [0, n) run, and keeping N/8 dense bytes per level
+    per identity is the O(N)-per-identity memory the swarm runtime removes.
+    Supports exactly the read surface the store/partitioner/evaluator use on
+    a retired best: length, cardinality, membership, indices, superset
+    algebra, and (rarely) a dense materialization for the wire.
+    """
+
+    __slots__ = ("_n",)
+
+    def __init__(self, length: int):
+        if length < 0:
+            raise ValueError("bitset length must be >= 0")
+        self._n = length
+
+    def __len__(self) -> int:
+        return self._n
+
+    def cardinality(self) -> int:
+        return self._n
+
+    def get(self, idx: int) -> bool:
+        if not 0 <= idx < self._n:
+            raise IndexError(f"bit {idx} out of range [0,{self._n})")
+        return True
+
+    def all(self) -> bool:
+        return True
+
+    def none(self) -> bool:
+        return self._n == 0
+
+    def any(self) -> bool:
+        return self._n > 0
+
+    def next_set(self, start: int = 0) -> int | None:
+        return start if start < self._n else None
+
+    def indices(self) -> range:
+        return range(self._n)
+
+    def clone(self) -> "AllOnesBitSet":
+        return self  # immutable
+
+    def is_superset(self, other) -> bool:
+        if self._n != len(other):
+            raise ValueError(
+                f"bitset length mismatch: {self._n} vs {len(other)}"
+            )
+        return True
+
+    def intersection_cardinality(self, other) -> int:
+        if self._n != len(other):
+            raise ValueError(
+                f"bitset length mismatch: {self._n} vs {len(other)}"
+            )
+        return other.cardinality()
+
+    def to_dense(self) -> BitSet:
+        bs = BitSet(self._n)
+        bs.set_range(0, self._n)
+        return bs
+
+    def marshal(self) -> bytes:
+        return self.to_dense().marshal()
+
+    def __repr__(self) -> str:
+        return f"AllOnesBitSet({self._n})"

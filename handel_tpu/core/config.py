@@ -61,6 +61,11 @@ class Config:
     new_processing: Optional[Callable] = None
     # (handel, levels) -> TimeoutStrategy; default = LinearTimeout
     new_timeout: Optional[Callable] = None
+    # signature-store class (SignatureStore ctor signature); None =
+    # SignatureStore. The swarm runtime passes WindowedSignatureStore so
+    # completed levels retire their individual-sig structures and memory
+    # stays O(active levels) per identity (core/store.py)
+    new_store: Optional[Callable] = None
 
     logger: Logger = DEFAULT_LOGGER
     # entropy for per-level candidate shuffling (config.go:55)

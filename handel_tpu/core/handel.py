@@ -48,7 +48,10 @@ class Level:
         if id <= 0:
             raise ValueError("level id must be >= 1")
         self.id = id
-        self.nodes = list(nodes)
+        # any indexable sequence works (list, RegistrySlice): keeping a lazy
+        # range view instead of copying makes a level O(1) memory — summed
+        # over levels and co-resident swarm nodes, copies would be O(N²)
+        self.nodes = nodes if hasattr(nodes, "__getitem__") else list(nodes)
         self.send_started = False
         self.rcv_completed = False
         self.send_pos = 0
@@ -136,10 +139,21 @@ def create_levels(
     first_active = False
     send_expected_full_size = 1
     for lvl in partitioner.levels():
-        nodes = list(partitioner.identities_at(lvl))
+        nodes = partitioner.identities_at(lvl)
         if not config.disable_shuffling:
+            # shuffling forces a real copy; with it disabled (the swarm
+            # default) the partitioner's O(1) range view is kept as-is
+            nodes = list(nodes)
             shuffle(nodes, config.rand)
         levels[lvl] = Level(lvl, nodes, send_expected_full_size, scorer)
+        if config.disable_shuffling:
+            # un-shuffled candidate order is IDENTICAL for every node in a
+            # sibling subtree, so a send_pos of 0 would aim the whole
+            # subtree's fast-path burst at the level's first `count`
+            # candidates and starve the rest until gossip rotates there.
+            # Deriving the rotation start from our own id spreads the burst
+            # uniformly with none of shuffling's per-node list copies.
+            levels[lvl].send_pos = partitioner.id % len(nodes)
         send_expected_full_size += len(nodes)
         if not first_active:
             levels[lvl].set_started()
@@ -215,7 +229,8 @@ class Handel:
         # instead of one host pairing-library add per contribution; host
         # constructors get no shim and the store keeps its serial path
         self.combine_shim = CombineShim.for_constructor(constructor)
-        self.store = SignatureStore(
+        store_cls = self.c.new_store or SignatureStore
+        self.store = store_cls(
             self.partitioner,
             self.c.new_bitset,
             constructor,
@@ -281,15 +296,22 @@ class Handel:
 
     # -- lifecycle (handel.go:156-182) -------------------------------------
 
-    def start(self) -> None:
+    def start(self, periodic: bool = True) -> None:
         """Start processing, timeouts and the periodic updater. Must be called
-        from a running asyncio event loop."""
+        from a running asyncio event loop.
+
+        `periodic=False` skips the per-node updater task: an external ticker
+        (core/timeout.py TimerWheel, driving thousands of co-resident swarm
+        nodes off ONE task) calls `periodic_update()` instead — an asyncio
+        task per node is exactly what the virtual-node runtime exists to
+        avoid."""
         self.start_time = time.monotonic()
         self.proc.start()
         self.timeout.start()
-        self._periodic_task = asyncio.get_running_loop().create_task(
-            self._periodic_loop()
-        )
+        if periodic:
+            self._periodic_task = asyncio.get_running_loop().create_task(
+                self._periodic_loop()
+            )
 
     def stop(self) -> None:
         self.timeout.stop()
@@ -302,6 +324,11 @@ class Handel:
     async def _periodic_loop(self) -> None:
         while True:
             await asyncio.sleep(self.c.update_period)
+            self._periodic_update()
+
+    def periodic_update(self) -> None:
+        """External-ticker entry (TimerWheel): one gossip round, now."""
+        if not self.done:
             self._periodic_update()
 
     def _periodic_update(self) -> None:
@@ -482,12 +509,15 @@ class Handel:
 
     def _check_final_signature(self, sp: IncomingSig) -> None:
         """Emit a new best full signature above the threshold (handel.go:271-296)."""
-        sig = self.store.full_signature()
-        if sig is None or sig.cardinality() < self.threshold:
+        card = self.store.full_cardinality()
+        if card < self.threshold:
             return
-        if self.best is not None and sig.cardinality() <= self.best.cardinality():
+        if self.best is not None and card <= self.best.cardinality():
             return
         if self.done:
+            return
+        sig = self.store.full_signature()
+        if sig is None:
             return
         first = self.best is None
         self.best = sig
@@ -531,9 +561,20 @@ class Handel:
                         cat="protocol",
                         args={"level": sp.level},
                     )
+                # windowed stores (core/store.py) free the level's individual
+                # sig structures once nothing at this level can improve —
+                # memory O(active levels) per identity at swarm scale
+                retire = getattr(self.store, "retire_level", None)
+                if retire is not None:
+                    retire(sp.level)
 
         for lid, up in self.levels.items():
             if lid < sp.level + 1:
+                continue
+            # update_sig_to_send rejects anything not strictly better than
+            # what this level already propagated; the disjoint-range
+            # cardinality sum answers that without paying for the combine
+            if self.store.combined_cardinality(lid - 1) <= up.send_sig_size:
                 continue
             ms = self.store.combined(lid - 1)
             if ms is not None and up.update_sig_to_send(ms):

@@ -46,12 +46,58 @@ class Registry:
         """Identities in [from_idx, to_idx) — empty on out-of-range."""
         raise NotImplementedError
 
+    def identity_range(self, from_idx: int, to_idx: int) -> "RegistrySlice":
+        """O(1) read-only view of [from_idx, to_idx) — no per-call copy.
+
+        The swarm runtime keeps one Handel instance per identity in one
+        process; per-level candidate LISTS are the sum-over-levels ≈ N
+        references per node, i.e. O(N²) pointers across a committee. A
+        shared view makes level candidate sets O(1) per node instead.
+        """
+        lo = max(0, from_idx)
+        hi = min(self.size(), to_idx)
+        return RegistrySlice(self, lo, max(lo, hi))
+
+
+class RegistrySlice(Sequence):
+    """Lazy contiguous registry window: Sequence protocol over identity(i)."""
+
+    __slots__ = ("_reg", "_lo", "_hi")
+
+    def __init__(self, registry: Registry, lo: int, hi: int):
+        self._reg = registry
+        self._lo = lo
+        self._hi = hi
+
+    def __len__(self) -> int:
+        return self._hi - self._lo
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            lo, hi, step = idx.indices(len(self))
+            if step == 1:
+                return RegistrySlice(self._reg, self._lo + lo, self._lo + hi)
+            return [self._reg.identity(self._lo + i) for i in range(lo, hi, step)]
+        if idx < 0:
+            idx += len(self)
+        if not 0 <= idx < len(self):
+            raise IndexError(idx)
+        return self._reg.identity(self._lo + idx)
+
+    def __iter__(self):
+        for i in range(self._lo, self._hi):
+            yield self._reg.identity(i)
+
+    def __repr__(self) -> str:
+        return f"RegistrySlice([{self._lo},{self._hi}))"
+
 
 class ArrayRegistry(Registry):
     """Dense array-backed registry (identity.go:60-98)."""
 
     def __init__(self, identities: Sequence[Identity]):
         self._ids = list(identities)
+        self._pks: list[PublicKey] | None = None
         for i, ident in enumerate(self._ids):
             if ident.id != i:
                 raise ValueError(f"registry identity {i} has id {ident.id}")
@@ -68,7 +114,11 @@ class ArrayRegistry(Registry):
         return self._ids[from_idx:to_idx]
 
     def public_keys(self) -> list[PublicKey]:
-        return [i.public_key for i in self._ids]
+        # cached: every co-resident Handel instance asks for this list, and
+        # a fresh N-element copy per instance is another O(N²) at swarm scale
+        if self._pks is None:
+            self._pks = [i.public_key for i in self._ids]
+        return self._pks
 
 
 def shuffle(items: list, seed_rng: random.Random) -> None:

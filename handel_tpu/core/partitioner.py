@@ -70,6 +70,11 @@ class BinomialPartitioner:
         self.size = registry.size()
         self.bitsize = log2_ceil(self.size)
         self.logger = logger
+        # ranges are pure functions of (id, size, level): memoized, because
+        # every combine() walks them per level per verified contribution —
+        # the binary search was ~15% of a swarm block's CPU before caching
+        self._range_cache: dict[int, tuple[int, int]] = {}
+        self._range_inv_cache: dict[int, tuple[int, int]] = {}
 
     def max_level(self) -> int:
         return self.bitsize
@@ -80,6 +85,9 @@ class BinomialPartitioner:
         partitioner.go:133-178. Raises EmptyLevelError when the subtree falls
         entirely beyond `size` (non-power-of-two registries).
         """
+        cached = self._range_cache.get(level)
+        if cached is not None:
+            return cached
         if level < 0 or level > self.bitsize + 1:
             raise InvalidLevelError(f"level {level} out of range")
         lo, hi = 0, pow2(self.bitsize)
@@ -102,7 +110,9 @@ class BinomialPartitioner:
             idx -= 1
         if lo >= self.size:
             raise EmptyLevelError(f"level {level} empty for id {self.id}")
-        return lo, min(hi, self.size)
+        out = (lo, min(hi, self.size))
+        self._range_cache[level] = out
+        return out
 
     def range_level_inverse(self, level: int) -> tuple[int, int]:
         """[min, max) of *our own* subtree at `level` (partitioner.go:185-211).
@@ -111,6 +121,9 @@ class BinomialPartitioner:
         must cover — peers at that level expect everything below `level` from
         our side of the tree.
         """
+        cached = self._range_inv_cache.get(level)
+        if cached is not None:
+            return cached
         if level < 0 or level > self.bitsize + 1:
             raise InvalidLevelError(f"level {level} out of range")
         lo, hi = 0, pow2(self.bitsize)
@@ -123,7 +136,9 @@ class BinomialPartitioner:
             else:
                 hi = middle
             idx -= 1
-        return lo, min(hi, self.size)
+        out = (lo, min(hi, self.size))
+        self._range_inv_cache[level] = out
+        return out
 
     def size_of(self, level: int) -> int:
         """Number of peers at `level`; 0 for empty levels (partitioner.go:213-222)."""
@@ -145,8 +160,15 @@ class BinomialPartitioner:
         return out
 
     def identities_at(self, level: int) -> Sequence[Identity]:
+        """Candidate identities at `level` as an O(1) range view.
+
+        Level ranges are contiguous by construction, so no copy is needed:
+        at swarm scale (one Handel per identity, co-resident) materialized
+        candidate lists are Σ-over-levels ≈ N references per node — O(N²)
+        across the committee — while views keep it O(levels) per node.
+        """
         lo, hi = self.range_level(level)
-        ids = self.reg.identities(lo, hi)
+        ids = self.reg.identity_range(lo, hi)
         if not ids and hi > lo:
             raise ValueError("registry can't find ids in range")
         return ids
@@ -217,8 +239,15 @@ class BinomialPartitioner:
         for s in sigs:
             off = offset_of(s)
             bs = s.ms.bitset
-            for i in bs.indices():
-                bitset.set(off + i, True)
+            if hasattr(bitset, "or_embed"):
+                # word-level shift-or (+ O(1) run fill for retired AllOnes
+                # levels): combined()/full_signature() run per verified
+                # contribution, and a per-index embed of a complete level is
+                # O(N) Python per event — untenable at swarm scale
+                bitset.or_embed(bs, off)
+            else:
+                for i in bs.indices():
+                    bitset.set(off + i, True)
             parts.append(s.ms.signature)
         if not parts:
             final_sig = None

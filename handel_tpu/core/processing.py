@@ -589,8 +589,10 @@ class BatchProcessing:
         if len(sp.ms.bitset) != hi - lo:
             raise ValueError("inconsistent bitset with given level")
         out = BitSet(len(self.pubkeys))
-        for i in sp.ms.bitset.indices():
-            out.set(lo + i, True)
+        # word-level shift-or: this runs once per device-bound candidate,
+        # and a per-index Python loop over a 32k-wide top level is the kind
+        # of O(N) per event the swarm runtime cannot afford
+        out.or_embed(sp.ms.bitset, lo)
         return out
 
     async def _default_verifier(self, msg, pubkeys, requests):

@@ -20,9 +20,10 @@ design (SURVEY.md §5.2).
 from __future__ import annotations
 
 from collections import OrderedDict
+from hashlib import blake2b
 from typing import Callable
 
-from handel_tpu.core.bitset import BitSet
+from handel_tpu.core.bitset import AllOnesBitSet, BitSet
 from handel_tpu.core.crypto import Constructor, MultiSignature
 from handel_tpu.core.partitioner import BinomialPartitioner, IncomingSig
 
@@ -33,9 +34,9 @@ class VerifiedAggCache:
     Handel's gossip pattern re-delivers the same winning aggregate from many
     peers per level (the reference re-verifies every copy,
     processing.go:258-287); each re-verification burns a device lane.  This
-    cache keys a candidate by its exact content — (level, bitset words,
-    signature bytes) — so a copy this node has already judged short-circuits
-    to the remembered verdict with zero device work.  Negative verdicts are
+    cache keys a candidate by its exact content — (level, digest of bitset
+    words + signature bytes) — so a copy this node has already judged
+    short-circuits to the remembered verdict with zero device work.  Negative verdicts are
     cached too: a known-bad aggregate re-sent by a byzantine peer costs
     nothing after the first pairing check.
 
@@ -59,12 +60,26 @@ class VerifiedAggCache:
         self.misses = 0
 
     @staticmethod
+    def content_digest(bitset, signature) -> bytes:
+        """16-byte blake2b over the exact bitset words + signature bytes.
+        Keys store the digest, not the raw words: a top-level bitset in a
+        65k committee is 4 KB of words, and with one cache per vnode the
+        raw-words keys were a measured multi-GB term in the swarm memory
+        curve (ISSUE 11). 128-bit content hash — collision odds at any
+        reachable entry count are negligible next to the protocol's own
+        failure modes."""
+        h = blake2b(bitset.words().tobytes(), digest_size=16)
+        h.update(signature.marshal())
+        return h.digest()
+
+    @staticmethod
     def key(scope, ms: MultiSignature) -> tuple:
         """Content identity of a candidate: scope (level, message, or a
         (session, level) pair — the multi-tenant service prepends the
         session id so identical bytes in two sessions never cross-dedup),
-        exact bitset words, exact signature bytes."""
-        return (scope, ms.bitset.words().tobytes(), ms.signature.marshal())
+        plus the content digest of the exact bitset words and signature
+        bytes."""
+        return (scope, VerifiedAggCache.content_digest(ms.bitset, ms.signature))
 
     def drop_scope(self, scope) -> int:
         """Forget every verdict whose key LEADS with `scope` — either as
@@ -143,16 +158,31 @@ class SignatureStore:
         # best multisignature per level (store.go:43)
         self.best_by_level: dict[int, MultiSignature] = {}
         self.highest = 0
-        # which individual sigs we have verified, per level (store.go:55)
+        # which individual sigs we have verified, per level (store.go:55).
+        # Allocated LAZILY on first touch: eagerly preallocating every
+        # level's bitset is Σ size_of(level) ≈ N bits per identity, which is
+        # O(N²) across a co-resident swarm committee before any packet flows
         self.indiv_verified: dict[int, BitSet] = {0: new_bitset(1)}
         # the verified individual sigs themselves (store.go:58)
         self.individual_sigs: dict[int, dict[int, MultiSignature]] = {0: {}}
-        for lvl in partitioner.levels():
-            self.indiv_verified[lvl] = new_bitset(partitioner.size_of(lvl))
-            self.individual_sigs[lvl] = {}
         # reporter counters (report.go:80-87)
         self.replace_trial = 0
         self.success_replace = 0
+        # combined()/full_signature() results are pure functions of
+        # best_by_level; gossip re-sends the SAME bests every period, so the
+        # recombination (bitset embeds + signature folds) is memoized on a
+        # generation counter bumped whenever a level's best changes
+        self._gen = 0
+        self._combined_cache: dict[int, tuple[int, MultiSignature | None]] = {}
+        self._full_cache: tuple[int, MultiSignature | None] | None = None
+
+    def _iv(self, level: int) -> BitSet:
+        """The level's verified-individuals bitset, created on first touch."""
+        bs = self.indiv_verified.get(level)
+        if bs is None:
+            bs = self.indiv_verified[level] = self.nbs(self.part.size_of(level))
+            self.individual_sigs.setdefault(level, {})
+        return bs
 
     # -- evaluation (store.go:101-183) -------------------------------------
 
@@ -169,7 +199,7 @@ class SignatureStore:
 
         if cur_best is not None and to_receive == cur_best.cardinality():
             return 0  # completed level: nothing more to gain
-        if sp.individual and self.indiv_verified[sp.level].get(sp.mapped_index):
+        if sp.individual and self._iv(sp.level).get(sp.mapped_index):
             return 0  # already verified this exact individual sig
         if (
             cur_best is not None
@@ -179,7 +209,7 @@ class SignatureStore:
             return 0  # strictly dominated by what we already have
 
         # what we'd have after patching with known-verified individual sigs
-        with_indiv = sp.ms.bitset.or_(self.indiv_verified[sp.level])
+        with_indiv = sp.ms.bitset.or_(self._iv(sp.level))
         if cur_best is None:
             new_total = with_indiv.cardinality()
             added_sigs = new_total
@@ -214,12 +244,13 @@ class SignatureStore:
         if sp.individual:
             if sp.ms.cardinality() != 1:
                 raise AssertionError("individual sig with cardinality != 1")
-            self.indiv_verified[sp.level].set(sp.mapped_index, True)
+            self._iv(sp.level).set(sp.mapped_index, True)
             self.individual_sigs[sp.level][sp.mapped_index] = sp.ms
 
         new_ms, should_store = self._check_merge(sp)
         if should_store:
             self.best_by_level[sp.level] = new_ms
+            self._gen += 1
             if sp.level > self.highest:
                 self.highest = sp.level
         return new_ms
@@ -245,7 +276,7 @@ class SignatureStore:
             parts.append(cur_best.signature)
 
         # patch holes with verified individual sigs (store.go:204-226)
-        vl = self.indiv_verified[sp.level]
+        vl = self._iv(sp.level)
         patchable = bits.and_(vl).xor(vl)
         if patchable.cardinality() + bits.cardinality() <= cur_best.cardinality():
             return None, False
@@ -277,27 +308,127 @@ class SignatureStore:
 
     def combined(self, level: int) -> MultiSignature | None:
         """Best combination of all levels <= `level`, sized for level+1's
-        candidate set (store.go:248-262)."""
+        candidate set (store.go:248-262). Memoized per generation — callers
+        (the gossip/fast-path send plane) treat the result as immutable."""
+        hit = self._combined_cache.get(level)
+        if hit is not None and hit[0] == self._gen:
+            return hit[1]
         sigs = [
             IncomingSig(origin=-1, level=lvl, ms=ms)
             for lvl, ms in self.best_by_level.items()
             if lvl <= level
         ]
-        if level < self.part.max_level():
-            level += 1
-        return self.part.combine(sigs, level, self.nbs, combiner=self.combiner)
+        send_level = level + 1 if level < self.part.max_level() else level
+        ms = self.part.combine(sigs, send_level, self.nbs,
+                               combiner=self.combiner)
+        self._combined_cache[level] = (self._gen, ms)
+        return ms
+
+    def combined_cardinality(self, level: int) -> int:
+        """Cardinality `combined(level)` would have, without combining.
+
+        Level ranges are disjoint by construction, so the count is a plain
+        sum of per-level best cardinalities — O(levels) dict lookups. The
+        verified-signature actors use this to skip the (bitset-embed +
+        point-add) combine when the result cannot beat what was already
+        sent, which is the common case once a level has propagated.
+        """
+        return sum(
+            ms.cardinality()
+            for lvl, ms in self.best_by_level.items()
+            if lvl <= level
+        )
+
+    def full_cardinality(self) -> int:
+        """Cardinality `full_signature()` would have, without combining."""
+        return sum(ms.cardinality() for ms in self.best_by_level.values())
 
     def full_signature(self) -> MultiSignature | None:
-        """Registry-sized combination of everything we have (store.go:238-246)."""
+        """Registry-sized combination of everything we have (store.go:238-246).
+        Memoized per generation like `combined`."""
+        if self._full_cache is not None and self._full_cache[0] == self._gen:
+            return self._full_cache[1]
         sigs = [
             IncomingSig(origin=-1, level=lvl, ms=ms)
             for lvl, ms in self.best_by_level.items()
         ]
-        return self.part.combine_full(sigs, self.nbs, combiner=self.combiner)
+        ms = self.part.combine_full(sigs, self.nbs, combiner=self.combiner)
+        self._full_cache = (self._gen, ms)
+        return ms
 
     def values(self) -> dict[str, float]:
         """Reporter counters (report.go:80-87)."""
         return {
             "successReplace": float(self.success_replace),
             "replaceTrial": float(self.replace_trial),
+        }
+
+
+class WindowedSignatureStore(SignatureStore):
+    """SignatureStore whose completed levels RETIRE (ISSUE 11).
+
+    The reference store keeps every level's individual-sig structures for
+    the whole run — per identity that is Σ size_of(level) ≈ N bits of
+    verified-individual bitsets plus up to N stored individual sigs, i.e.
+    O(N) per identity and O(N²) across a co-resident swarm committee. Once a
+    level is receive-complete nothing at that level can improve (the best
+    already covers the full candidate range), so `retire_level`:
+
+    - drops the level's `indiv_verified` bitset and `individual_sigs` dict
+      (the only O(level size) state), and
+    - compacts the complete best's dense bitset to `AllOnesBitSet` — the
+      best AGGREGATE itself is never dropped; `combined()` and
+      `full_signature()` keep reading it through `best_by_level`.
+
+    Contributions arriving for a retired level afterwards (gossip
+    re-deliveries racing the completion, or stale peers) are
+    counted-and-ignored: `staleRetiredCt` in the reporter surface, zero
+    score, zero store mutation. Memory per identity is then O(active
+    levels), not O(N) — the property the 65k-committee run depends on.
+    """
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.retired: set[int] = set()
+        self.stale_retired_ct = 0
+
+    def retire_level(self, level: int) -> None:
+        """Free the level's individual-sig window; keep (and compact) the
+        best aggregate. Idempotent."""
+        if level in self.retired:
+            return
+        self.retired.add(level)
+        self.indiv_verified.pop(level, None)
+        self.individual_sigs.pop(level, None)
+        best = self.best_by_level.get(level)
+        if (
+            best is not None
+            and not isinstance(best.bitset, AllOnesBitSet)
+            and best.cardinality() == len(best.bitset)
+        ):
+            self.best_by_level[level] = MultiSignature(
+                AllOnesBitSet(len(best.bitset)), best.signature
+            )
+            self._gen += 1  # cached combinations reference the old object
+
+    def evaluate(self, sp: IncomingSig) -> int:
+        if sp.level in self.retired:
+            self.stale_retired_ct += 1
+            return 0
+        return super().evaluate(sp)
+
+    def store(self, sp: IncomingSig) -> MultiSignature | None:
+        if sp.level in self.retired:
+            # a candidate verified before the level completed, landing
+            # after: the level's window is gone and the best already covers
+            # it — count, ignore, return the standing best
+            self.stale_retired_ct += 1
+            return self.best_by_level.get(sp.level)
+        return super().store(sp)
+
+    def values(self) -> dict[str, float]:
+        return {
+            **super().values(),
+            "staleRetiredCt": float(self.stale_retired_ct),
+            "retiredLevelCt": float(len(self.retired)),
         }

@@ -274,17 +274,28 @@ class BatchVerifierService:
         return self.plane.inflight_launches()
 
     async def verify(
-        self, msg, pubkeys, requests, session: str = ""
+        self, msg, pubkeys, requests, session: str = "",
+        dedup_scope: str | None = None,
     ) -> list[bool]:
         """AsyncVerifier-compatible entry (core/processing.py). `session`
         tags the requests with their aggregation instance: fairness,
-        dedup scope and queue bounds are all keyed by it."""
+        admission bounds and teardown are all keyed by it. Dedup verdicts
+        are keyed by `dedup_scope` when given, else by `session`: the swarm
+        runtime (handel_tpu/swarm/) runs one session per COMMITTEE MEMBER,
+        and every member of one committee sees the same winning aggregates —
+        a shared scope lets the whole committee cross-dedup identical
+        content while fairness still isolates per-member queues. Distinct
+        committees must pass distinct scopes (the tenant-isolation rule
+        from the class docstring, one level up)."""
         if self._task is None:
             self.start()
         loop = asyncio.get_running_loop()
+        scope = session if dedup_scope is None else dedup_scope
         futs = []
         for bs, sig in requests:
-            key = (session, msg, bs.words().tobytes(), sig.marshal())
+            # content digest, not raw words: one 65k-committee bitset is
+            # 4 KB of words and this cache holds thousands of entries
+            key = (scope, msg, VerifiedAggCache.content_digest(bs, sig))
             cached = self.cache.get(key)
             if cached is not None:
                 # some co-located node of this session already verified
@@ -331,13 +342,18 @@ class BatchVerifierService:
         self._kick.set()
         return list(await asyncio.gather(*futs))
 
-    def session_verifier(self, session: str):
+    def session_verifier(self, session: str, dedup_scope: str | None = None):
         """A Config.verifier-shaped wrapper tagging every request with
         `session` (the per-node pipeline's verifier contract has no session
-        argument — the tag rides the closure)."""
+        argument — the tag rides the closure). `dedup_scope` overrides the
+        verdict-cache scope (see `verify`); the swarm passes its committee
+        id so co-resident members share verdicts."""
 
         async def verify(msg, pubkeys, requests):
-            return await self.verify(msg, pubkeys, requests, session=session)
+            return await self.verify(
+                msg, pubkeys, requests, session=session,
+                dedup_scope=dedup_scope,
+            )
 
         return verify
 

@@ -7,6 +7,7 @@ Usage: python -m handel_tpu.sim --config sim.toml --workdir out/
        python -m handel_tpu.sim trace <trace-dir>   (analyze a traced run)
        python -m handel_tpu.sim watch sim.toml      (live /metrics dashboard)
        python -m handel_tpu.sim serve sim.toml      (multi-session service)
+       python -m handel_tpu.sim swarm sim.toml      (virtual-node swarm)
 """
 
 from __future__ import annotations
@@ -45,6 +46,20 @@ def main() -> int:
 
         cfg = load_config(sargs.config)
         summary = asyncio.run(run_service(cfg, sargs.workdir, sargs.config))
+        print(json.dumps(summary))
+        return 0 if summary["ok"] else 1
+    if len(sys.argv) > 1 and sys.argv[1] == "swarm":
+        # virtual-node swarm subcommand (handel_tpu/swarm/driver.py): run
+        # the [swarm] TOML section's N identities as cooperative vnodes
+        # multiplexed over a few event-loop processes
+        wap = argparse.ArgumentParser(prog="python -m handel_tpu.sim swarm")
+        wap.add_argument("config")
+        wap.add_argument("--workdir", default="swarm_out")
+        wargs = wap.parse_args(sys.argv[2:])
+        from handel_tpu.swarm.driver import run_swarm
+
+        cfg = load_config(wargs.config)
+        summary = asyncio.run(run_swarm(cfg, wargs.workdir, wargs.config))
         print(json.dumps(summary))
         return 0 if summary["ok"] else 1
     ap = argparse.ArgumentParser()

@@ -15,15 +15,34 @@ from __future__ import annotations
 
 import os
 
-from handel_tpu.sim.config import HandelParams, RunConfig, SimConfig, dump_config
+from handel_tpu.sim.config import (
+    HandelParams,
+    RunConfig,
+    SimConfig,
+    SwarmParams,
+    dump_config,
+)
 
 # the reference's standard sweep (confgenerator.go nodesCount scenarios)
 NODE_SWEEP = [100, 300, 500, 1000, 2000, 4000]
 
+# ceiling on generated worker processes: the uncapped n//500 rule was an
+# AWS-fleet assumption — at swarm scale it emits configs asking one host
+# for 131 Python processes (65536 nodes), which fork-bombs a laptop and
+# adds nothing once processes exceed cores. Above this, use the swarm
+# runtime (scenario_swarm) which multiplexes identities as vnodes instead.
+MAX_PROCESSES = 16
+
+
+def default_processes(n: int) -> int:
+    """Process count for an n-node run: the reference's one-per-500 rule,
+    capped at MAX_PROCESSES."""
+    return min(max(1, n // 500), MAX_PROCESSES)
+
 
 def _runs(nodes_list, threshold_of, failing_of=lambda n: 0, processes_of=None, **hp):
     if processes_of is None:
-        processes_of = lambda n: max(1, n // 500)
+        processes_of = default_processes
     return [
         RunConfig(
             nodes=n,
@@ -53,7 +72,7 @@ def scenario_threshold_inc(nodes: int = 2000) -> SimConfig:
         scheme="bn254-jax",
         runs=[
             RunConfig(nodes=nodes, threshold=nodes * pct // 100,
-                      processes=max(1, nodes // 500))
+                      processes=default_processes(nodes))
             for pct in (51, 75, 90, 99)
         ],
     )
@@ -70,7 +89,7 @@ def scenario_failing(nodes: int = 4000) -> SimConfig:
                 nodes=nodes,
                 threshold=nodes * 51 // 100,
                 failing=f,
-                processes=max(1, nodes // 500),
+                processes=default_processes(nodes),
             )
             for f in (0, nodes // 10, nodes // 4, nodes * 49 // 100)
         ],
@@ -161,11 +180,32 @@ def scenario_evaluator(nodes: int = 2000) -> SimConfig:
             RunConfig(
                 nodes=nodes,
                 threshold=nodes * 99 // 100,
-                processes=max(1, nodes // 500),
+                processes=default_processes(nodes),
                 handel=HandelParams(evaluator=ev),
             )
             for ev in ("store", "eval1", "fifo")
         ],
+    )
+
+
+def scenario_swarm(identities: int = 65536, processes: int = 1) -> SimConfig:
+    """Virtual-node swarm run (handel_tpu/swarm/; `sim swarm`): identities
+    beyond what per-node processes can carry, multiplexed as vnodes on a
+    shared event loop. Gossip is set sparse — the in-memory router is
+    lossless and the id-staggered fast-path cascade covers every level
+    deterministically, so each gossip round only costs CPU (roughly
+    identities x active-levels deliveries per period on one core)."""
+    return SimConfig(
+        trace=True,
+        trace_capacity=1 << 22,
+        swarm=SwarmParams(
+            identities=identities,
+            processes=processes,
+            period_ms=120000.0,
+            timeout_ms=50.0,
+            fast_path=3,
+            timeout_s=5400.0,
+        ),
     )
 
 
@@ -180,6 +220,7 @@ SCENARIOS = {
     "nsquare": scenario_nsquare,
     "gossipsub": scenario_gossipsub,
     "practical": scenario_practical,
+    "swarm": scenario_swarm,
 }
 
 

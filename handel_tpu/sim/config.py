@@ -142,6 +142,41 @@ class ServiceParams:
 
 
 @dataclass
+class SwarmParams:
+    """`[swarm]` section: the virtual-node runtime (handel_tpu/swarm/).
+
+    One committee of `identities` members, every member a co-resident
+    virtual node, sharded over `processes` worker processes in contiguous
+    ID blocks. identities = 0 keeps swarm mode off; `sim swarm` requires
+    it > 0. The fake scheme is implied — swarm scale is a host-runtime
+    experiment, not a pairing benchmark (the verify plane still runs
+    through the shared BatchVerifierService so the launch path is real).
+    """
+
+    identities: int = 0
+    processes: int = 1
+    threshold: int = 0  # 0 -> default percentage of `identities`
+    period_ms: float = 2000.0  # vnode gossip period. The in-memory router is
+    # lossless and candidate order is id-staggered, so the fast-path cascade
+    # alone covers every level deterministically; gossip is a repair net, and
+    # every period costs ~identities × active-levels deliveries of CPU.
+    timeout_ms: float = 50.0  # level-start timeout per vnode
+    fast_path: int = 3  # completed-level burst fanout. With id-staggered
+    # candidate order each peer receives exactly this many copies per level,
+    # so it is the redundancy factor of the wave (10, the WAN default, just
+    # multiplies single-core CPU by 3x for no extra coverage)
+    tick_ms: float = 10.0  # TimerWheel resolution
+    batch_size: int = 64  # shared verifier launch width
+    max_pending: int = 256  # per-vnode unverified-candidate bound
+    chunk_bits: int = 12  # registry pager chunk = 2^chunk_bits identities
+    page_budget: int = 64  # resident chunks per process
+    timeout_s: float = 0.0  # run deadline; 0 -> global max_timeout_s
+
+    def enabled(self) -> bool:
+        return self.identities > 0
+
+
+@dataclass
 class HostSpec:
     """One host of the remote platform's fleet (sim/remote.py; the analog
     of an aws.go instance entry)."""
@@ -194,6 +229,8 @@ class SimConfig:
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
     # -- multi-tenant service (handel_tpu/service/; `sim serve`) -----------
     service: ServiceParams = field(default_factory=ServiceParams)
+    # -- virtual-node swarm (handel_tpu/swarm/; `sim swarm`) ---------------
+    swarm: SwarmParams = field(default_factory=SwarmParams)
     # -- remote platform (sim/remote.py; aws.go analog) --------------------
     hosts: list[HostSpec] = field(default_factory=list)
     master_ip: str = "127.0.0.1"  # address remote nodes dial the master at
@@ -248,6 +285,21 @@ def load_config(path: str) -> SimConfig:
         batch_size=int(sv.get("batch_size", 0)),
         spawn_stagger_ms=float(sv.get("spawn_stagger_ms", 0.0)),
         period_ms=float(sv.get("period_ms", 10.0)),
+    )
+    sw = raw.get("swarm", {})
+    cfg.swarm = SwarmParams(
+        identities=int(sw.get("identities", 0)),
+        processes=int(sw.get("processes", 1)),
+        threshold=int(sw.get("threshold", 0)),
+        period_ms=float(sw.get("period_ms", 2000.0)),
+        timeout_ms=float(sw.get("timeout_ms", 50.0)),
+        fast_path=int(sw.get("fast_path", 3)),
+        tick_ms=float(sw.get("tick_ms", 10.0)),
+        batch_size=int(sw.get("batch_size", 64)),
+        max_pending=int(sw.get("max_pending", 256)),
+        chunk_bits=int(sw.get("chunk_bits", 12)),
+        page_budget=int(sw.get("page_budget", 64)),
+        timeout_s=float(sw.get("timeout_s", 0.0)),
     )
     for h in raw.get("hosts", []):
         cfg.hosts.append(
@@ -339,6 +391,23 @@ def dump_config(cfg: SimConfig) -> str:
             f"batch_size = {cfg.service.batch_size}",
             f"spawn_stagger_ms = {cfg.service.spawn_stagger_ms}",
             f"period_ms = {cfg.service.period_ms}",
+        ]
+    if cfg.swarm.enabled():
+        lines += [
+            "",
+            "[swarm]",
+            f"identities = {cfg.swarm.identities}",
+            f"processes = {cfg.swarm.processes}",
+            f"threshold = {cfg.swarm.threshold}",
+            f"period_ms = {cfg.swarm.period_ms}",
+            f"timeout_ms = {cfg.swarm.timeout_ms}",
+            f"fast_path = {cfg.swarm.fast_path}",
+            f"tick_ms = {cfg.swarm.tick_ms}",
+            f"batch_size = {cfg.swarm.batch_size}",
+            f"max_pending = {cfg.swarm.max_pending}",
+            f"chunk_bits = {cfg.swarm.chunk_bits}",
+            f"page_budget = {cfg.swarm.page_budget}",
+            f"timeout_s = {cfg.swarm.timeout_s}",
         ]
     for h in cfg.hosts:
         lines += [

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import math
 import time
 
 STATE_START = 1
@@ -81,7 +82,10 @@ class SyncMaster:
     ) -> None:
         self._seen.setdefault(state, set()).add(node_id)
         self._addrs.setdefault(state, set()).add(addr)
-        need = max(1, int(self.expected * RELEASE_FRACTION))
+        # ceil, not floor: for small fleets int() would release the barrier
+        # one participant early (expected=2 -> int(1.99) = 1), letting a
+        # block start gossiping before its sibling can even receive
+        need = max(1, math.ceil(self.expected * RELEASE_FRACTION))
         if len(self._seen[state]) >= need:
             self._event(state).set()
         if self._event(state).is_set():

@@ -29,9 +29,13 @@ from __future__ import annotations
 
 import argparse
 import glob
+import heapq
 import json
+import math
 import os
 import sys
+from array import array
+from collections import OrderedDict
 
 from handel_tpu.core.trace import merge_traces
 
@@ -49,19 +53,30 @@ STAGE_OF = {
 }
 
 
-def load_exports(paths: list[str]) -> list[dict]:
-    """Load the raw per-process exports (clockOffset intact) from files
-    and/or directories of trace_*.json."""
+def resolve_trace_files(paths: list[str]) -> list[str]:
+    """Expand directories into their trace dumps (node trace_*.json and
+    swarm swarm_trace_*.json both count)."""
     files: list[str] = []
     for p in paths:
         if os.path.isdir(p):
             files.extend(sorted(glob.glob(os.path.join(p, "trace_*.json"))))
+            files.extend(
+                sorted(glob.glob(os.path.join(p, "swarm_trace_*.json")))
+            )
         else:
             files.append(p)
     if not files:
         raise FileNotFoundError(f"no trace_*.json under {paths}")
+    return files
+
+
+def load_exports(paths: list[str]) -> list[dict]:
+    """Load the raw per-process exports (clockOffset intact). Holds every
+    file at once — fine for small runs and the merge/plot paths; the
+    analysis pipeline itself streams (`stream_report`), because a 65k-node
+    swarm's dumps do not fit an analyst laptop's memory all at once."""
     exports = []
-    for f in files:
+    for f in resolve_trace_files(paths):
         with open(f) as fh:
             exports.append(json.load(fh))
     return exports
@@ -193,64 +208,51 @@ def _interval_union(ivs: list[tuple[float, float]]) -> float:
     return covered
 
 
-def critical_path(events: list[dict]) -> dict | None:
-    """Walk the threshold-reaching aggregate backwards to a contributor's
-    first send — the slowest CAUSAL chain, not a heuristic stitching.
+class _TraceIndex:
+    """The span indexes the critical-path walk needs — built over one
+    process's export (streamed path) or the whole merged run
+    (`critical_path`)."""
 
-    Anchor: the fleet's earliest `threshold_reached` instant. From the
-    merge span enclosing it, the local pipeline is matched by
-    (pid, tid, origin, level, rts); the cross-process hop resolves the
-    merge's packet span id to the SENDER's `send` span, then recurses into
-    the merge that produced that send (fast-path sends happen inside the
-    producing merge's interval, core/handel.py _check_completed_level).
-    The walk ends at a send with no producing merge — the contribution's
-    origin. Returns None when the trace holds no threshold instant.
+    def __init__(self, events: list[dict] = ()):
+        self.merges: dict[tuple, list[dict]] = {}
+        self.pipeline: dict[tuple, dict[str, list[dict]]] = {}
+        self.transits: dict[tuple, list[dict]] = {}
+        self.sends: dict[int, dict] = {}
+        self.device_ivs: dict[int, list[tuple[float, float]]] = {}
+        if events:
+            self.add_events(events)
 
-    Verify time overlapping the shared service's `device_verify` launches
-    (same process) is re-attributed to the `device` stage, so host-queue
-    wait and chip wall are separated in the stage breakdown.
-    """
-    thresholds = [
-        e for e in events
-        if e.get("ph") == "i" and e.get("name") == "threshold_reached"
-    ]
-    if not thresholds:
-        return None
-    anchor = min(thresholds, key=lambda e: e["ts"])
+    def add_events(self, events: list[dict]) -> None:
+        for e in events:
+            if e.get("ph") != "X":
+                continue
+            name, a = e.get("name"), e.get("args", {})
+            pt = (e.get("pid", 0), e.get("tid", 0))
+            if name == "merge":
+                self.merges.setdefault(pt, []).append(e)
+            if name in ("merge", "verify", "queue", "recv") and "rts" in a:
+                key = pt + (a.get("origin"), a.get("level"), a["rts"])
+                self.pipeline.setdefault(key, {}).setdefault(
+                    name, []
+                ).append(e)
+            elif name == "net_transit":
+                self.transits.setdefault(
+                    pt + (a.get("origin"), a.get("level")), []
+                ).append(e)
+            elif name == "send" and a.get("span"):
+                self.sends[a["span"]] = e
+            elif name == "device_verify":
+                self.device_ivs.setdefault(e.get("pid", 0), []).append(
+                    (e["ts"], e["ts"] + e.get("dur", 0.0))
+                )
+        for evs in self.merges.values():
+            evs.sort(key=lambda e: e["ts"] + e.get("dur", 0.0))
 
-    merges: dict[tuple, list[dict]] = {}
-    pipeline: dict[tuple, dict[str, list[dict]]] = {}
-    transits: dict[tuple, list[dict]] = {}
-    sends: dict[int, dict] = {}
-    device_ivs: dict[int, list[tuple[float, float]]] = {}
-    for e in events:
-        if e.get("ph") != "X":
-            continue
-        name, a = e.get("name"), e.get("args", {})
-        pt = (e.get("pid", 0), e.get("tid", 0))
-        if name == "merge":
-            merges.setdefault(pt, []).append(e)
-        if name in ("merge", "verify", "queue", "recv") and "rts" in a:
-            key = pt + (a.get("origin"), a.get("level"), a["rts"])
-            pipeline.setdefault(key, {}).setdefault(name, []).append(e)
-        elif name == "net_transit":
-            transits.setdefault(
-                pt + (a.get("origin"), a.get("level")), []
-            ).append(e)
-        elif name == "send" and a.get("span"):
-            sends[a["span"]] = e
-        elif name == "device_verify":
-            device_ivs.setdefault(e.get("pid", 0), []).append(
-                (e["ts"], e["ts"] + e.get("dur", 0.0))
-            )
-    for evs in merges.values():
-        evs.sort(key=lambda e: e["ts"] + e.get("dur", 0.0))
-
-    def enclosing_merge(pt: tuple, ts: float) -> dict | None:
+    def enclosing_merge(self, pt: tuple, ts: float) -> dict | None:
         """The merge containing ts on (pid, tid), else the latest one
         ending at/before ts (a periodic resend of an earlier merge)."""
         best = None
-        for m in merges.get(pt, ()):
+        for m in self.merges.get(pt, ()):
             lo, hi = m["ts"], m["ts"] + m.get("dur", 0.0)
             if lo <= ts <= hi:
                 return m
@@ -258,6 +260,7 @@ def critical_path(events: list[dict]) -> dict | None:
                 best = m  # sorted by end: the last such wins
         return best
 
+    @staticmethod
     def pick(evs: list[dict] | None, span: int) -> dict | None:
         """Prefer the event whose span arg matches; else the longest."""
         if not evs:
@@ -266,35 +269,59 @@ def critical_path(events: list[dict]) -> dict | None:
         pool = same or evs
         return max(pool, key=lambda e: e.get("dur", 0.0))
 
+
+def _walk_chain(anchor: dict, index_of, send_of) -> list[dict]:
+    """The backwards walk shared by `critical_path` and `stream_report`:
+    `index_of(pid)` resolves a process's _TraceIndex (the streamed path
+    loads it lazily), `send_of(span)` resolves a packet span id to the
+    sender's send event wherever that process's dump lives."""
     chain: list[dict] = []
-    visited: set[int] = set()
-    cur = enclosing_merge((anchor.get("pid", 0), anchor.get("tid", 0)),
-                          anchor["ts"])
-    while cur is not None and id(cur) not in visited:
-        visited.add(id(cur))
-        a = cur.get("args", {})
+    visited: set[tuple] = set()
+    idx = index_of(anchor.get("pid", 0))
+    cur = None
+    if idx is not None:
+        cur = idx.enclosing_merge(
+            (anchor.get("pid", 0), anchor.get("tid", 0)), anchor["ts"]
+        )
+    while cur is not None:
         pt = (cur.get("pid", 0), cur.get("tid", 0))
+        mkey = pt + (cur["ts"],)  # value identity: stable across reloads
+        if mkey in visited:
+            break
+        visited.add(mkey)
+        a = cur.get("args", {})
         key = pt + (a.get("origin"), a.get("level"), a.get("rts"))
         span = a.get("span", 0)
         hop = [cur]
-        stages = pipeline.get(key, {})
+        stages = idx.pipeline.get(key, {})
         for name in ("verify", "queue", "recv"):
-            m = pick(stages.get(name), span)
+            m = _TraceIndex.pick(stages.get(name), span)
             if m is not None:
                 hop.append(m)
-        nt = pick(transits.get(pt + (a.get("origin"), a.get("level"))), span)
+        nt = _TraceIndex.pick(
+            idx.transits.get(pt + (a.get("origin"), a.get("level"))), span
+        )
         if nt is not None:
             hop.append(nt)
         chain.extend(hop)
-        send = sends.get(span) if span else None
+        send = send_of(span) if span else None
         if send is None:
             break
         chain.append(send)
-        cur = enclosing_merge(
-            (send.get("pid", 0), send.get("tid", 0)), send["ts"]
-        )
+        idx = index_of(send.get("pid", 0))
+        cur = None
+        if idx is not None:
+            cur = idx.enclosing_merge(
+                (send.get("pid", 0), send.get("tid", 0)), send["ts"]
+            )
+    return chain
 
-    chain.reverse()  # origin-first: contributor's send ... -> final merge
+
+def _chain_to_report(chain: list[dict], anchor: dict, device_ivs_of) -> dict:
+    """Fold a walked chain into the critical-path report dict;
+    `device_ivs_of(pid)` yields that process's device_verify intervals for
+    the verify -> device re-attribution."""
+    chain = list(reversed(chain))  # origin-first: send ... -> final merge
     start = min(e["ts"] for e in chain) if chain else anchor["ts"]
     wall = anchor["ts"] - start
     ivs = [
@@ -310,7 +337,7 @@ def critical_path(events: list[dict]) -> dict | None:
             # chip wall inside the verify window attributes to `device`
             on_dev = _interval_union([
                 (max(lo, dlo), min(hi, dhi))
-                for dlo, dhi in device_ivs.get(e.get("pid", 0), ())
+                for dlo, dhi in device_ivs_of(e.get("pid", 0))
                 if dhi > lo and dlo < hi
             ])
             stages_us["device"] = stages_us.get("device", 0.0) + on_dev
@@ -342,6 +369,37 @@ def critical_path(events: list[dict]) -> dict | None:
             for e in chain
         ],
     }
+
+
+def critical_path(events: list[dict]) -> dict | None:
+    """Walk the threshold-reaching aggregate backwards to a contributor's
+    first send — the slowest CAUSAL chain, not a heuristic stitching.
+
+    Anchor: the fleet's earliest `threshold_reached` instant. From the
+    merge span enclosing it, the local pipeline is matched by
+    (pid, tid, origin, level, rts); the cross-process hop resolves the
+    merge's packet span id to the SENDER's `send` span, then recurses into
+    the merge that produced that send (fast-path sends happen inside the
+    producing merge's interval, core/handel.py _check_completed_level).
+    The walk ends at a send with no producing merge — the contribution's
+    origin. Returns None when the trace holds no threshold instant.
+
+    Verify time overlapping the shared service's `device_verify` launches
+    (same process) is re-attributed to the `device` stage, so host-queue
+    wait and chip wall are separated in the stage breakdown.
+    """
+    thresholds = [
+        e for e in events
+        if e.get("ph") == "i" and e.get("name") == "threshold_reached"
+    ]
+    if not thresholds:
+        return None
+    anchor = min(thresholds, key=lambda e: e["ts"])
+    idx = _TraceIndex(events)
+    chain = _walk_chain(anchor, lambda pid: idx, idx.sends.get)
+    return _chain_to_report(
+        chain, anchor, lambda pid: idx.device_ivs.get(pid, ())
+    )
 
 
 def flow_linkage(events: list[dict]) -> tuple[float, int, int]:
@@ -386,6 +444,217 @@ def lane_occupancy(events: list[dict]) -> dict:
         )
     mean = sum(lanes.values()) / len(lanes) if lanes else 0.0
     return {"mean": mean, "lanes": lanes}
+
+
+def _load_shifted(path: str) -> tuple[dict, list[dict]]:
+    """One export, its clock offset already applied to event timestamps
+    (the per-file half of core/trace.py merge_traces)."""
+    with open(path) as fh:
+        ex = json.load(fh)
+    evs = ex.get("traceEvents", [])
+    off = float(ex.get("clockOffset", 0.0) or 0.0) * 1e6
+    if off:
+        for e in evs:
+            if "ts" in e:
+                e["ts"] += off
+    return ex, evs
+
+
+class _ExportStream:
+    """Lazy per-process _TraceIndex cache for the streamed critical-path
+    walk: the walk touches O(hops) processes, so at most `cap` dumps are
+    ever resident at once."""
+
+    def __init__(self, file_of_pid: dict[int, str], cap: int = 4):
+        self._files = file_of_pid
+        self._cache: OrderedDict[str, _TraceIndex] = OrderedDict()
+        self._cap = cap
+
+    def index_of(self, pid: int) -> _TraceIndex | None:
+        f = self._files.get(pid)
+        if f is None:
+            return None
+        idx = self._cache.get(f)
+        if idx is None:
+            idx = _TraceIndex(_load_shifted(f)[1])
+            self._cache[f] = idx
+            while len(self._cache) > self._cap:
+                self._cache.popitem(last=False)
+        else:
+            self._cache.move_to_end(f)
+        return idx
+
+
+def stream_report(paths: list[str], top_k: int = 10) -> dict:
+    """build_report over trace dumps WITHOUT holding them all in memory:
+    one pass, one file resident at a time — a 65,536-vnode swarm's dumps
+    don't fit an analyst machine all at once. Per-file events fold into
+    bounded state (level-wave timestamp arrays, span count/total/max,
+    span-id -> pid for the cross-process hops, a top-k heap of the slowest
+    contribution chains); the critical path then walks backwards loading
+    only the O(hops) dumps it actually visits (_ExportStream)."""
+    files = resolve_trace_files(paths)
+    t0 = math.inf
+    anchor: dict | None = None
+    level_ts: dict[int, array] = {}
+    span_agg: dict[str, list[float]] = {}
+    send_pid: dict[int, int] = {}
+    recv_span_ct: dict[int, int] = {}
+    recv_total = 0
+    lane_ivs: dict[tuple, list[tuple[float, float]]] = {}
+    file_of_pid: dict[int, str] = {}
+    offsets: list[float] = []
+    heap: list[tuple] = []
+    chain_ct, cov_sum, cov_min = 0, 0.0, math.inf
+    seq = events_total = 0
+
+    for f in files:
+        ex, evs = _load_shifted(f)
+        offsets.append(float(ex.get("clockOffset", 0.0) or 0.0))
+        for e in evs:
+            ph = e.get("ph")
+            if ph not in ("X", "i"):
+                continue
+            events_total += 1
+            ts = e["ts"]
+            if ts < t0:
+                t0 = ts
+            pid = e.get("pid", 0)
+            if pid not in file_of_pid:
+                file_of_pid[pid] = f
+            name = e.get("name")
+            if ph == "i":
+                if name == "level_complete":
+                    lvl = int(e.get("args", {}).get("level", -1))
+                    level_ts.setdefault(lvl, array("d")).append(ts)
+                elif name == "threshold_reached" and (
+                    anchor is None or ts < anchor["ts"]
+                ):
+                    anchor = e
+                continue
+            dur = e.get("dur", 0.0)
+            row = span_agg.get(name)
+            if row is None:
+                span_agg[name] = [1, dur, dur]
+            else:
+                row[0] += 1
+                row[1] += dur
+                if dur > row[2]:
+                    row[2] = dur
+            a = e.get("args", {})
+            if name == "send":
+                if a.get("span"):
+                    send_pid[a["span"]] = pid
+            elif name == "recv":
+                if "span" in a:
+                    recv_total += 1
+                    if a["span"]:
+                        recv_span_ct[a["span"]] = (
+                            recv_span_ct.get(a["span"], 0) + 1
+                        )
+            elif name == "launch_on_device":
+                lane_ivs.setdefault((pid, e.get("tid", 0)), []).append(
+                    (ts, ts + dur)
+                )
+        # chain spans for one contribution all live on the recipient's
+        # recorder, so per-file chain extraction is exact
+        for key, c in contribution_chains(evs).items():
+            chain_ct += 1
+            cov_sum += c["coverage"]
+            if c["coverage"] < cov_min:
+                cov_min = c["coverage"]
+            seq += 1
+            item = (c["wall_ms"], seq, key, c)
+            if len(heap) < top_k:
+                heapq.heappush(heap, item)
+            elif item[0] > heap[0][0]:
+                heapq.heapreplace(heap, item)
+        del ex, evs
+
+    cp = None
+    if anchor is not None:
+        stream = _ExportStream(file_of_pid)
+
+        def send_of(span: int) -> dict | None:
+            spid = send_pid.get(span)
+            if spid is None:
+                return None
+            idx = stream.index_of(spid)
+            return idx.sends.get(span) if idx is not None else None
+
+        def device_ivs_of(pid: int):
+            idx = stream.index_of(pid)
+            return idx.device_ivs.get(pid, ()) if idx is not None else ()
+
+        chain = _walk_chain(anchor, stream.index_of, send_of)
+        cp = _chain_to_report(chain, anchor, device_ivs_of)
+
+    wave = {}
+    for lvl in sorted(level_ts):
+        srt = sorted(level_ts[lvl])
+        wave[str(lvl)] = {
+            "first": (srt[0] - t0) / 1e6,
+            "median": (srt[len(srt) // 2] - t0) / 1e6,
+            "last": (srt[-1] - t0) / 1e6,
+        }
+    linked = sum(
+        ct for span, ct in recv_span_ct.items() if span in send_pid
+    )
+    lanes = {}
+    for (pid, tid), ivs in sorted(lane_ivs.items()):
+        window = max(hi for _, hi in ivs) - min(lo for lo, _ in ivs)
+        lanes[f"{pid}/{tid}"] = (
+            _interval_union(ivs) / window if window > 0 else 1.0
+        )
+    tts = cp["wall_ms"] / 1e3 if cp else 0.0
+    return {
+        "metric": "trace_time_to_threshold_s",
+        "value": tts,
+        "backend": "trace",
+        "time_to_threshold_s": tts,
+        "critical_path_coverage": cp["coverage"] if cp else 0.0,
+        "critical_path_len": cp["hops"] if cp else 0,
+        "flow_linkage": (linked / recv_total) if recv_total else 0.0,
+        "flow_linked": linked,
+        "flow_total": recv_total,
+        "lane_occupancy": (
+            sum(lanes.values()) / len(lanes) if lanes else 0.0
+        ),
+        "lanes": lanes,
+        "critical_path": cp,
+        "levels_s": wave,
+        "level_wave": wave,
+        "span_table": [
+            {
+                "name": n,
+                "count": int(c),
+                "total_ms": tot / 1e3,
+                "mean_ms": tot / c / 1e3,
+                "max_ms": mx / 1e3,
+            }
+            for n, (c, tot, mx) in sorted(
+                span_agg.items(), key=lambda kv: -kv[1][1]
+            )
+        ],
+        "chains": {
+            "count": chain_ct,
+            "coverage_min": cov_min if chain_ct else 0.0,
+            "coverage_mean": cov_sum / chain_ct if chain_ct else 0.0,
+            "slowest": [
+                {
+                    "pid": key[0],
+                    "tid": key[1],
+                    "origin": key[2],
+                    "level": key[3],
+                    **c,
+                }
+                for _, _, key, c in sorted(heap, reverse=True)
+            ],
+        },
+        "clock_offsets_s": offsets,
+        "events": events_total,
+        "files": len(files),
+    }
 
 
 def build_report(events: list[dict], exports: list[dict] | None = None) -> dict:
@@ -455,7 +724,10 @@ def main(argv: list[str]) -> int:
     ap.add_argument("paths", nargs="+", help="trace dir(s) or trace_*.json files")
     ap.add_argument("--merged", default="", help="write combined Chrome trace JSON")
     ap.add_argument("--plot", default="", help="write the aggregation-wave PNG")
-    ap.add_argument("--top", type=int, default=10, help="attribution rows shown")
+    ap.add_argument(
+        "--top", "--top-k", dest="top", type=int, default=10,
+        help="rows kept/shown per table (bounds per-chain output too)",
+    )
     ap.add_argument(
         "--critical-path", action="store_true",
         help="walk + print the causal chain to threshold",
@@ -466,18 +738,23 @@ def main(argv: list[str]) -> int:
     )
     args = ap.parse_args(argv)
 
-    exports = load_exports(args.paths)
-    events = merge_traces(exports)["traceEvents"]
-    print(f"{len(events)} events loaded")
+    # one file resident at a time: a 65k-node swarm's dumps stream through
+    report = stream_report(args.paths, top_k=args.top)
+    print(
+        f"{report['events']} events streamed from {report['files']} file(s)"
+    )
 
-    wave = level_timeline(events)
+    wave = report["levels_s"]
     if wave:
         print("\naggregation wave (level completion, s since first event):")
         print(f"{'level':>6} {'first':>9} {'median':>9} {'last':>9} ")
-        for lvl, (first, med, last) in wave.items():
-            print(f"{lvl:>6} {first:>9.4f} {med:>9.4f} {last:>9.4f}")
+        for lvl, w in wave.items():
+            print(
+                f"{int(lvl):>6} {w['first']:>9.4f} {w['median']:>9.4f} "
+                f"{w['last']:>9.4f}"
+            )
 
-    rows = span_table(events)
+    rows = report["span_table"]
     if rows:
         print("\nslowest-span attribution:")
         print(f"{'span':>14} {'count':>8} {'total ms':>11} {'mean ms':>9} {'max ms':>9}")
@@ -487,54 +764,59 @@ def main(argv: list[str]) -> int:
                 f"{r['mean_ms']:>9.3f} {r['max_ms']:>9.3f}"
             )
 
-    chains = contribution_chains(events)
-    if chains:
-        worst = sorted(chains.items(), key=lambda kv: -kv[1]["wall_ms"])
-        cov = [c["coverage"] for c in chains.values()]
+    ch = report["chains"]
+    if ch["count"]:
         print(
-            f"\n{len(chains)} contribution chains; span coverage "
-            f"min={min(cov):.1%} median={sorted(cov)[len(cov) // 2]:.1%}"
+            f"\n{ch['count']} contribution chains; span coverage "
+            f"min={ch['coverage_min']:.1%} mean={ch['coverage_mean']:.1%}"
         )
         print("slowest contributions (recv -> merge):")
-        for (pid, tid, origin, level, _rts, _ind), c in worst[: args.top]:
+        for c in ch["slowest"]:
             stages = " ".join(
                 f"{n}={ms:.2f}ms" for n, ms in c["stages"].items()
             )
             print(
-                f"  node {tid} origin={origin} level={level}: "
+                f"  node {c['tid']} origin={c['origin']} level={c['level']}: "
                 f"{c['wall_ms']:.2f} ms ({c['coverage']:.0%} attributed) {stages}"
             )
 
     if args.critical_path:
-        print_critical_path(critical_path(events))
-        linkage, linked, total = flow_linkage(events)
-        occ = lane_occupancy(events)
+        print_critical_path(report["critical_path"])
         print(
-            f"\nflow linkage: {linked}/{total} recvs resolved to their "
-            f"sender's span ({linkage:.1%})"
+            f"\nflow linkage: {report['flow_linked']}/{report['flow_total']} "
+            f"recvs resolved to their sender's span "
+            f"({report['flow_linkage']:.1%})"
         )
-        if occ["lanes"]:
+        if report["lanes"]:
             print(
                 "lane occupancy: "
                 + "  ".join(
-                    f"{k}={v:.1%}" for k, v in occ["lanes"].items()
+                    f"{k}={v:.1%}" for k, v in report["lanes"].items()
                 )
-                + f"  (mean {occ['mean']:.1%})"
+                + f"  (mean {report['lane_occupancy']:.1%})"
             )
 
     if args.report:
         with open(args.report, "w") as f:
-            json.dump(build_report(events, exports), f, indent=1)
+            json.dump(report, f, indent=1)
         print(f"\ntrace report -> {args.report}")
 
     if args.merged:
+        # the one path that genuinely needs every event resident
+        events = merge_traces(load_exports(args.paths))["traceEvents"]
         with open(args.merged, "w") as f:
             json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
         print(f"\nmerged trace -> {args.merged}")
     if args.plot:
         from handel_tpu.sim.plots import plot_trace_timeline
 
-        plot_trace_timeline(wave, args.plot)
+        plot_trace_timeline(
+            {
+                int(k): (w["first"], w["median"], w["last"])
+                for k, w in wave.items()
+            },
+            args.plot,
+        )
         print(f"wave plot -> {args.plot}")
     return 0
 

@@ -62,6 +62,12 @@ SIDE_METRICS = {
     "critical_path_coverage": "higher",
     "flow_linkage": "higher",
     "lane_occupancy": "higher",
+    # virtual-node swarm (bench.py swarm_bench / sim swarm): identities one
+    # host carries, summed-RSS bytes per identity (the 1M extrapolation
+    # basis), and wall until the LAST member held a threshold signature
+    "swarm_identities": "higher",
+    "mem_bytes_per_identity": "lower",
+    "swarm_time_to_threshold_s": "lower",
 }
 
 

@@ -8,10 +8,11 @@ buffer.
 """
 
 import random
+import struct
 
 import pytest
 
-from handel_tpu.core.bitset import BitSet
+from handel_tpu.core.bitset import MAX_WIRE_BITS, BitSet
 from handel_tpu.core.crypto import MultiSignature
 from handel_tpu.core.net import Packet
 from handel_tpu.models.fake import FakeConstructor, FakeSignature
@@ -158,7 +159,84 @@ def test_multisig_unmarshal_fuzz():
             ms = MultiSignature.unmarshal(data, cons)
         except ValueError:
             continue
-        assert len(ms.bitset) <= 0xFFFF
+        # the wire cap is MAX_WIRE_BITS since the extended (escape) form —
+        # swarm committees marshal bitsets well past the legacy 0xFFFF
+        assert len(ms.bitset) <= MAX_WIRE_BITS
+
+
+def test_bitset_sparse_roundtrip_property():
+    """Sparse (varint-delta) wire form: random sizes past the legacy
+    0xFFFF cap with sparse populations must round-trip exactly and beat
+    the dense encoding (that is the only reason marshal picks it)."""
+    rng = random.Random(9)
+    for _ in range(50):
+        n = rng.randrange(1, MAX_WIRE_BITS + 1)
+        bs = BitSet(n)
+        for _ in range(rng.randrange(0, 16)):
+            bs.set(rng.randrange(n), True)
+        wire = bs.marshal()
+        assert len(wire) < (n + 7) // 8 + 7 or n < 512
+        out, used = BitSet.unmarshal(wire)
+        assert used == len(wire)
+        assert out == bs and out.cardinality() == bs.cardinality()
+
+
+def test_bitset_extended_dense_roundtrip():
+    """Dense populations past 0xFFFF take the extended-dense escape."""
+    rng = random.Random(10)
+    for n in (0xFFFF, 0x10000, 0x10001, 1 << 17):
+        bs = BitSet(n)
+        bs.set_range(0, n // 2)
+        for _ in range(64):
+            bs.set(rng.randrange(n), True)
+        out, used = BitSet.unmarshal(bs.marshal())
+        assert used == len(bs.marshal())
+        assert out == bs
+
+
+def test_bitset_sparse_truncation_raises():
+    """Every prefix cut of a sparse encoding raises ValueError — varint
+    payloads must not silently yield a shorter population."""
+    bs = BitSet(1 << 20)
+    for i in range(0, 1 << 20, 1 << 16):
+        bs.set(i, True)
+    wire = bs.marshal()
+    for cut in range(len(wire)):
+        with pytest.raises(ValueError):
+            BitSet.unmarshal(wire[:cut])
+
+
+def test_bitset_extended_header_fuzz():
+    """Arbitrary bytes after the escape marker: valid object or ValueError,
+    and any declared length beyond MAX_WIRE_BITS is rejected up front (a
+    forged header must not drive a huge allocation)."""
+    rng = random.Random(11)
+    escape = struct.pack(">H", 0xFFFF)
+    for _ in range(500):
+        data = escape + rng.randbytes(rng.randrange(0, 24))
+        try:
+            bs, used = BitSet.unmarshal(data)
+        except ValueError:
+            continue
+        assert used <= len(data)
+        assert len(bs) <= MAX_WIRE_BITS
+    for n in (MAX_WIRE_BITS + 1, 1 << 30, 0xFFFFFFFF):
+        for mode in (0, 1):
+            with pytest.raises(ValueError):
+                BitSet.unmarshal(struct.pack(">HBI", 0xFFFF, mode, n))
+
+
+def test_multisig_sparse_roundtrip_through_packet():
+    """A high-level aggregate (sparse, past the legacy cap) survives the
+    full Packet encode/decode path."""
+    bs = BitSet(1 << 18)
+    for i in (0, 17, 4096, 65535, 65536, (1 << 18) - 1):
+        bs.set(i, True)
+    ms = MultiSignature(bs, FakeSignature())
+    p = Packet(origin=7, level=18, multisig=ms.marshal())
+    q = Packet.decode(p.encode())
+    out = MultiSignature.unmarshal(q.multisig, FakeConstructor())
+    assert out.bitset == bs
 
 
 def test_multisig_unmarshal_truncated_signature():

@@ -20,6 +20,70 @@ def run(coro):
     return asyncio.run(coro)
 
 
+def test_levels_structure_65536():
+    """N=65536 with the fake scheme: the structural assumptions that broke
+    above 2^12 — every level a contiguous O(1) range view (no materialized
+    candidate lists), the 16 level ranges tiling the full ID space, and the
+    un-shuffled send rotation staggered per id so sibling subtrees don't aim
+    their fast-path bursts at the same candidates (core/handel.py
+    create_levels)."""
+    from handel_tpu.core.config import Config
+    from handel_tpu.core.handel import create_levels
+    from handel_tpu.core.partitioner import BinomialPartitioner
+    from handel_tpu.swarm.driver import fake_committee
+
+    n = 65536
+    registry, _ = fake_committee(n)
+    for nid in (0, 1, 4097, 32767, 32768, n - 1):
+        part = BinomialPartitioner(nid, registry)
+        assert part.max_level() == 16
+        assert part.levels() == list(range(1, 17))
+        # the level ranges plus our own id tile [0, n) exactly once
+        seen = {nid}
+        for lvl in part.levels():
+            lo, hi = part.range_level(lvl)
+            assert hi - lo == 1 << (lvl - 1)
+            assert not (set(range(lo, hi)) & seen) or hi - lo > 4096
+            if hi - lo <= 4096:
+                seen.update(range(lo, hi))
+        assert part.size_of(16) == 32768
+        cfg = Config(disable_shuffling=True)
+        levels = create_levels(cfg, part)
+        for lvl, level in levels.items():
+            # O(1) range views, never list copies of up-to-32768 identities
+            assert not isinstance(level.nodes, list)
+            assert len(level.nodes) == part.size_of(lvl)
+            assert level.send_pos == nid % len(level.nodes)
+    # full-tile check on one node without the sample shortcut
+    part = BinomialPartitioner(12345, registry)
+    total = 1  # our own id
+    for lvl in part.levels():
+        lo, hi = part.range_level(lvl)
+        total += hi - lo
+    assert total == n
+
+
+def test_levels_structure_non_power_of_two_above_2_12():
+    """Non-power-of-two committees above 4096: top levels may be partial or
+    empty; ranges must clamp to the registry size and never go negative."""
+    from handel_tpu.core.partitioner import BinomialPartitioner, EmptyLevelError
+    from handel_tpu.swarm.driver import fake_committee
+
+    n = 40000  # between 2^15 and 2^16
+    registry, _ = fake_committee(n)
+    for nid in (0, n // 2, n - 1):
+        part = BinomialPartitioner(nid, registry)
+        covered = 1
+        for lvl in range(1, part.max_level() + 1):
+            try:
+                lo, hi = part.range_level(lvl)
+            except EmptyLevelError:
+                continue
+            assert 0 <= lo < hi <= n
+            covered += hi - lo
+        assert covered == n
+
+
 @pytest.mark.slow
 def test_full_aggregation_128():
     results = run(run_cluster(128, timeout=60.0))

@@ -205,3 +205,103 @@ def test_combined_uses_batched_combiner():
     ms = store.full_signature()
     assert ms is not None and ms.signature.tag == 10 + 100 + 1000
     assert len(log) == 1 and len(log[0]) == 3
+
+
+# -- WindowedSignatureStore (ISSUE 11: swarm memory window) ----------------
+
+
+def make_windowed(n=16, id=1):
+    from handel_tpu.core.store import WindowedSignatureStore
+
+    part = BinomialPartitioner(id, fake_registry(n))
+    return WindowedSignatureStore(part), part
+
+
+def _complete_level(store, part, level):
+    """Deliver the level's full aggregate and retire it, as
+    _check_completed_level would."""
+    lo, hi = part.range_level(level)
+    sp = inc(level, range(hi - lo), hi - lo)
+    store.store(sp)
+    store.retire_level(level)
+
+
+def test_retirement_never_drops_best_aggregate():
+    """After retire_level the level's best is still readable and combined()/
+    full_signature() still cover it — only the individual-sig window dies."""
+    store, part = make_windowed()
+    for lvl in (1, 2, 3):
+        _complete_level(store, part, lvl)
+    for lvl in (1, 2, 3):
+        best = store.best(lvl)
+        assert best is not None
+        assert best.cardinality() == part.size_of(lvl)
+    full = store.full_signature()
+    want = 1 + sum(part.size_of(l) for l in (1, 2, 3))  # own id implied absent
+    assert full.cardinality() == want - 1  # store holds levels 1-3 only
+    assert store.combined_cardinality(3) == full.cardinality()
+
+
+def test_retired_best_compacts_to_all_ones():
+    """A complete retired best is swapped for the O(1) AllOnesBitSet run —
+    same coverage, none of the dense words."""
+    from handel_tpu.core.bitset import AllOnesBitSet
+
+    store, part = make_windowed()
+    _complete_level(store, part, 3)
+    assert isinstance(store.best(3).bitset, AllOnesBitSet)
+    # and the combine path still embeds it correctly
+    assert store.combined(3).cardinality() == part.size_of(3)
+
+
+def test_stale_redeliveries_counted_and_ignored():
+    """Contributions landing after retirement mutate nothing and bump
+    staleRetiredCt (gossip re-deliveries racing completion)."""
+    store, part = make_windowed()
+    _complete_level(store, part, 2)
+    best_before = store.best(2)
+    late = inc(2, [0], part.size_of(2))
+    assert store.evaluate(late) == 0
+    got = store.store(late)
+    assert got is best_before
+    assert store.best(2) is best_before
+    assert store.values()["staleRetiredCt"] == 2.0  # evaluate + store
+    assert store.values()["retiredLevelCt"] == 1.0
+
+
+def test_retire_level_idempotent():
+    store, part = make_windowed()
+    _complete_level(store, part, 1)
+    before = store.best(1)
+    store.retire_level(1)
+    store.retire_level(1)
+    assert store.best(1) is before
+    assert store.values()["retiredLevelCt"] == 1.0
+
+
+def test_windowed_memory_flat_as_levels_complete():
+    """deep_size of the store must not grow as levels complete: each
+    completed level's individual window is freed and its dense best
+    compacts, so the walk stays O(active levels) — the property the
+    65k-committee run depends on."""
+    from handel_tpu.swarm.mem import deep_size
+
+    n, nid = 256, 1
+    store, part = make_windowed(n=n, id=nid)
+    shared = (part, part.reg)
+    sizes = []
+    for lvl in part.levels():
+        lo, hi = part.range_level(lvl)
+        size = hi - lo
+        # individual deliveries first: builds the per-level window
+        for i in range(size):
+            store.store(inc(lvl, [i], size, is_ind=True, mapped=i))
+        sizes.append(deep_size(store, shared=shared))
+        store.retire_level(lvl)
+    retired_size = deep_size(store, shared=shared)
+    # retiring the last (largest) level must free its window: the final
+    # walk is smaller than the store was at its peak
+    assert retired_size < max(sizes)
+    # and the end state doesn't scale with N: it is bounded by the walk
+    # of the level-1 state (smallest window) plus slack for the bests
+    assert retired_size < sizes[0] + 64 * len(part.levels()) * 100

@@ -1,0 +1,313 @@
+"""Virtual-node swarm runtime (ISSUE 11 tentpole: handel_tpu/swarm/)."""
+
+import asyncio
+import json
+from types import SimpleNamespace
+
+import pytest
+
+from handel_tpu.core.bitset import BitSet
+from handel_tpu.core.net import Packet
+from handel_tpu.swarm.driver import (
+    SwarmHost,
+    _split,
+    fake_committee,
+    merge_summaries,
+)
+from handel_tpu.swarm.pager import PagedDevice, RegistryPager
+from handel_tpu.swarm.router import SwarmRouter
+from handel_tpu.swarm.vnode import SWARM_DEDUP_SCOPE, build_vnode
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class _Sink:
+    def __init__(self):
+        self.got = []
+
+    def new_packet(self, p):
+        self.got.append(p)
+
+
+def _idents(*ids):
+    return [SimpleNamespace(id=i) for i in ids]
+
+
+# -- router ----------------------------------------------------------------
+
+
+def test_router_local_short_circuit_shares_one_packet():
+    async def go():
+        r = SwarmRouter(block=16)
+        sinks = {i: _Sink() for i in (0, 1, 2)}
+        for i, s in sinks.items():
+            r.register(i, s)
+        p = Packet(origin=5, level=1, multisig=b"\x00\x08\xff")
+        r.route(_idents(0, 1, 2), p)
+        await asyncio.sleep(0)  # call_soon drains on the next loop turn
+        for s in sinks.values():
+            assert len(s.got) == 1
+            assert s.got[0] is p  # the SAME object, no encode/decode
+        v = r.values()
+        assert v["swarmLocalDelivered"] == 3.0
+        assert v["swarmUdpSent"] == 0.0
+
+    run(go())
+
+
+def test_router_unknown_recipient_counted_not_raised():
+    async def go():
+        r = SwarmRouter(block=16)  # no ports, no socket
+        r.route(_idents(99), Packet(origin=0, level=1, multisig=b""))
+        assert r.values()["swarmUnknownRecipient"] == 1.0
+
+    run(go())
+
+
+def test_router_udp_cross_process_frame():
+    """Two routers on real sockets: a packet for the other block rides the
+    shared socket with the recipient-id frame and decodes on arrival."""
+    from handel_tpu.sim.platform import free_ports
+
+    async def go():
+        ports = free_ports(2)
+        a = SwarmRouter(block=4, ports=ports)
+        b = SwarmRouter(block=4, ports=ports)
+        await a.open(ports[0])
+        await b.open(ports[1])
+        try:
+            sink = _Sink()
+            b.register(5, sink)  # id 5 // block 4 -> process 1
+            p = Packet(origin=0, level=2, multisig=b"\x00\x08\x0f")
+            a.route(_idents(5), p)
+            for _ in range(50):
+                if sink.got:
+                    break
+                await asyncio.sleep(0.01)
+            assert len(sink.got) == 1
+            q = sink.got[0]
+            assert (q.origin, q.level, q.multisig) == (0, 2, p.multisig)
+            assert a.values()["swarmUdpSent"] == 1.0
+            assert b.values()["swarmUdpRcvd"] == 1.0
+        finally:
+            a.close()
+            b.close()
+
+    run(go())
+
+
+def test_router_bad_datagrams_dropped_and_counted():
+    r = SwarmRouter(block=4)
+    r._on_datagram(b"\x00")  # shorter than the frame header
+    r._on_datagram(b"\x00\x00\x00\x63junk")  # unknown recipient 99
+    r.register(1, _Sink())
+    r._on_datagram(b"\x00\x00\x00\x01\xff")  # undecodable Packet payload
+    v = r.values()
+    assert v["swarmUdpRcvdBad"] == 2.0
+    assert v["swarmUnknownRecipient"] == 1.0
+
+
+# -- registry pager --------------------------------------------------------
+
+
+def test_pager_touched_chunks_from_words():
+    pager = RegistryPager(chunk_bits=6, budget_chunks=8)  # 64 ids per chunk
+    bs = BitSet(512)
+    bs.set(0)
+    bs.set(70)  # chunk 1
+    bs.set(511)  # chunk 7
+    assert pager.touched_chunks(bs) == {0, 1, 7}
+
+
+def test_pager_lru_eviction_and_hits():
+    committed = []
+    pager = RegistryPager(
+        chunk_bits=6, budget_chunks=2,
+        on_commit=lambda lo, hi: committed.append((lo, hi)),
+    )
+    pager.ensure({0, 1})
+    pager.ensure({0})  # hit, refreshes 0
+    pager.ensure({2})  # evicts 1 (LRU), not 0
+    assert pager.resident_chunks() == 2
+    assert committed == [(0, 64), (64, 128), (128, 192)]
+    v = pager.values()
+    assert v["pageHits"] == 1.0
+    assert v["pagesCommitted"] == 3.0
+    assert v["pageEvictions"] == 1.0
+
+
+def test_paged_device_pages_before_launch():
+    class _Engine:
+        batch_size = 4
+
+        def __init__(self):
+            self.launched = []
+
+        def dispatch_multi(self, items):
+            self.launched.append(len(items))
+            return "h"
+
+        def fetch(self, handle):
+            return [True]
+
+    eng = _Engine()
+    pager = RegistryPager(chunk_bits=6, budget_chunks=4)
+    dev = PagedDevice(eng, pager)
+    bs = BitSet(256)
+    bs.set(100)
+    assert dev.dispatch_multi([(b"m", None, bs, None)]) == "h"
+    assert dev.fetch("h") == [True]
+    assert eng.launched == [1]
+    assert pager.resident_chunks() == 1  # chunk 1 (ids 64-127)
+
+
+# -- share splitting / summary merge ---------------------------------------
+
+
+def test_split_contiguous_shares():
+    assert _split(10, 3) == [4, 3, 3]
+    assert _split(8, 2) == [4, 4]
+    assert _split(3, 5) == [1, 1, 1, 0, 0]
+    assert sum(_split(65536, 7)) == 65536
+
+
+def test_merge_summaries():
+    base = {
+        "threshold": 3, "vnode_bytes_mean": 100.0, "stale_retired_ct": 0,
+        "retired_level_ct": 2, "verifier_launches": 1,
+        "verifier_candidates": 2, "dedup_hits": 0,
+        "swarmLocalDelivered": 10.0, "swarmUdpSent": 0.0,
+        "swarmUdpRcvd": 0.0, "swarmUdpBytesSent": 0.0,
+        "pagesCommitted": 1.0, "pageHits": 0.0,
+    }
+    parts = [
+        {**base, "identities": 4, "completed": 4, "rss_bytes": 1000,
+         "ttt_max_s": 1.0, "wall_s": 2.0, "ttt_p50_s": 0.5,
+         "ttt_p90_s": 0.8},
+        {**base, "identities": 4, "completed": 3, "rss_bytes": 1000,
+         "ttt_max_s": 2.0, "wall_s": 2.5, "ttt_p50_s": 0.6,
+         "ttt_p90_s": 0.9},
+    ]
+    m = merge_summaries(parts)
+    assert m["swarm_identities"] == 8
+    assert m["completed"] == 7
+    assert m["ok"] is False
+    assert m["mem_bytes_per_identity"] == 250.0
+    assert m["swarm_time_to_threshold_s"] == 2.0
+    assert json.dumps(m)  # JSON-serializable whole
+
+
+# -- vnode wiring ----------------------------------------------------------
+
+
+def test_build_vnode_swarm_wiring():
+    """The knobs the memory budget depends on: windowed store, shared rand,
+    no shuffling, member-id session over a committee-wide dedup scope."""
+    import random
+
+    from handel_tpu.core.store import WindowedSignatureStore
+    from handel_tpu.core.timeout import TimerWheel
+    from handel_tpu.parallel.batch_verifier import BatchVerifierService
+    from handel_tpu.service.driver import HostDevice
+
+    async def go():
+        registry, secrets = fake_committee(16)
+        from handel_tpu.models.fake import FakeConstructor
+
+        cons = FakeConstructor()
+        router = SwarmRouter(block=16)
+        wheel = TimerWheel(tick_s=0.01)
+        service = BatchVerifierService(HostDevice(cons, batch_size=4))
+        shared = random.Random(0)
+        v = build_vnode(
+            registry.identity(3), secrets[3], registry, cons, b"m",
+            router, wheel, service,
+            threshold=9, update_period=0.05, level_timeout=0.05,
+            shared_rand=shared, fast_path=2,
+        )
+        h = v.handel
+        assert h.c.session == "3"
+        assert h.c.disable_shuffling is True
+        assert h.c.fast_path == 2
+        assert h.c.rand is shared
+        assert isinstance(h.store, WindowedSignatureStore)
+        assert h.scorer is None or not h.c.penalize_peers
+        assert router.local.get(3) is h  # listener registered under our id
+        service.stop()
+
+    run(go())
+
+
+def test_swarm_dedup_scope_shared():
+    assert SWARM_DEDUP_SCOPE == "swarm"
+
+
+# -- end-to-end single-process host ----------------------------------------
+
+
+def test_swarm_host_small_committee_completes():
+    async def go():
+        host = SwarmHost(64, 0, 64, update_period=0.5)
+        s = await host.run(timeout=30.0)
+        assert s["completed"] == 64
+        assert s["identities"] == 64
+        assert s["ttt_max_s"] > 0.0
+        assert s["retired_level_ct"] > 0
+        assert s["swarmLocalDelivered"] > 0
+        assert s["swarmUdpSent"] == 0.0  # single process: all local
+        return s
+
+    run(go())
+
+
+def test_swarm_host_traced_run_streams_report(tmp_path):
+    from handel_tpu.sim.trace_cli import stream_report
+
+    async def go():
+        host = SwarmHost(
+            32, 0, 32, update_period=0.5, trace=True, trace_capacity=1 << 15
+        )
+        s = await host.run(timeout=30.0)
+        assert s["completed"] == 32
+        path = host.recorder.dump(str(tmp_path / "swarm_trace_0.json"))
+        rep = stream_report([path], top_k=3)
+        assert rep["events"] > 0
+        assert rep["time_to_threshold_s"] >= 0.0
+        assert rep["level_wave"]  # the per-level completion wave
+        assert rep["chains"]["count"] > 0
+
+    run(go())
+
+
+def test_swarm_host_rollup_shape():
+    async def go():
+        host = SwarmHost(32, 0, 32, update_period=0.5)
+        await host.run(timeout=30.0)
+        r = host.rollup(top_k=4)
+        assert r["vnodes"] == 32
+        assert r["unfinished"] == 0
+        assert len(r["slowest"]) == 4
+        slow = [e["slow_s"] for e in r["slowest"]]
+        assert slow == sorted(slow, reverse=True)
+        assert "counters" in r and "gauges" in r
+
+    run(go())
+
+
+# -- barrier release count (sim/sync.py) -----------------------------------
+
+
+def test_sync_master_small_fleet_needs_everyone():
+    """expected=2 must NOT release after one READY: int(2*0.995) floors to
+    1 — the ceiling keeps a block from gossiping before its sibling binds."""
+    from handel_tpu.sim.sync import STATE_START, SyncMaster
+
+    sent = []
+    master = SyncMaster(0, expected=2)
+    master._transport = SimpleNamespace(sendto=lambda d, a: sent.append(a))
+    master._on_ready(STATE_START, 0, ("127.0.0.1", 1))
+    assert not master._event(STATE_START).is_set()
+    master._on_ready(STATE_START, 1, ("127.0.0.1", 2))
+    assert master._event(STATE_START).is_set()
