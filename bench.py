@@ -66,16 +66,73 @@ def _probe_default_backend(timeout_s: float = 90.0) -> bool:
         return False
 
 
+def _probe_cache_path() -> str:
+    """Host-local probe-verdict file (NOT a committed artifact): keyed into
+    the system tempdir so every checkout/run on one host shares it."""
+    import getpass
+    import tempfile
+
+    override = os.environ.get("HANDEL_TPU_PROBE_CACHE")
+    if override:
+        return override
+    try:
+        user = getpass.getuser()
+    except (KeyError, OSError):
+        user = str(os.getuid()) if hasattr(os, "getuid") else "any"
+    return os.path.join(tempfile.gettempdir(), f"handel_tpu_probe_{user}.json")
+
+
+def _cached_probe_failure() -> float | None:
+    """Age in seconds of a still-fresh cached 'unreachable' verdict, else
+    None (no cache / stale / last verdict was reachable)."""
+    ttl = float(os.environ.get("HANDEL_TPU_PROBE_CACHE_TTL_S", "3600"))
+    try:
+        with open(_probe_cache_path()) as f:
+            v = json.load(f)
+        if v.get("reachable"):
+            return None
+        age = time.time() - float(v["checked_at"])
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+    return age if 0 <= age < ttl else None
+
+
+def _record_probe_verdict(reachable: bool) -> None:
+    try:
+        write_json_atomic(
+            _probe_cache_path(),
+            {"reachable": reachable, "checked_at": time.time()},
+        )
+    except OSError:
+        pass  # a read-only tempdir must not fail the bench
+
+
 def _probe_with_retries() -> bool:
     """Probe the default backend repeatedly with backoff until it answers or
     the budget (default 10 min) is spent. A transient tunnel blip must not
-    cost a round's TPU evidence."""
+    cost a round's TPU evidence.
+
+    The verdict persists to a host-local cache: an unreachable backend costs
+    the full retry ladder once per host per TTL (default 1 h), not once per
+    run — BENCH_r05's tail showed the ~8.5 min ladder replaying on every
+    round of an outage. A reachable verdict is never trusted from cache (a
+    live probe succeeds in seconds and the tunnel can drop between runs)."""
     if os.environ.get("HANDEL_TPU_BENCH_FORCE_PROBE_FAIL"):
         # test hook: a deterministic outage. Masking JAX_PLATFORMS is not
         # enough — the environment's sitecustomize re-selects the real
         # platform through the config API inside the probe child, so with a
-        # live tunnel the outage path would be untestable
+        # live tunnel the outage path would be untestable. Never writes the
+        # host cache: a forced verdict must not poison real runs.
         print("bench: probe failure forced by env", file=sys.stderr)
+        return False
+    age = _cached_probe_failure()
+    if age is not None:
+        print(
+            f"bench: backend probe skipped — host cache says unreachable "
+            f"{age/60:.1f} min ago ({_probe_cache_path()}; delete or wait "
+            f"out HANDEL_TPU_PROBE_CACHE_TTL_S to re-probe)",
+            file=sys.stderr,
+        )
         return False
     budget = float(os.environ.get("HANDEL_TPU_PROBE_BUDGET_S", "600"))
     deadline = time.monotonic() + budget
@@ -87,13 +144,16 @@ def _probe_with_retries() -> bool:
         if left <= 0:
             print(f"bench: backend probe gave up after {attempt - 1} attempts",
                   file=sys.stderr)
+            _record_probe_verdict(False)
             return False
         if _probe_default_backend(timeout_s=min(90.0, max(left, 10.0))):
+            _record_probe_verdict(True)
             return True
         left = deadline - time.monotonic()
         if left <= 0:
             print(f"bench: backend probe gave up after {attempt} attempts",
                   file=sys.stderr)
+            _record_probe_verdict(False)
             return False
         print(
             f"bench: backend unreachable (attempt {attempt}), retrying in "
@@ -685,14 +745,15 @@ def _fp_microbench() -> None:
         return
     os.makedirs(os.path.dirname(FP_ARTIFACT), exist_ok=True)
     # carry forward side-channel captures (scripts/mxu_limb_lab.py merges
-    # an "mxu_lab" entry into this artifact): overwriting with only our
-    # own keys would destroy captured evidence
+    # an "mxu_lab" entry into this artifact) and the batch-scaling
+    # reconciliation note: overwriting with only our own keys would
+    # destroy captured evidence
     extra = {}
     if os.path.exists(FP_ARTIFACT):
         try:
             with open(FP_ARTIFACT) as f:
                 prev = json.load(f)
-            extra = {k: prev[k] for k in ("mxu_lab",) if k in prev}
+            extra = {k: prev[k] for k in ("mxu_lab", "note") if k in prev}
         except (json.JSONDecodeError, OSError):
             pass
     write_json_atomic(

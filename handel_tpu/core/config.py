@@ -101,6 +101,13 @@ class Config:
     # so N concurrent sessions sharing one process/device plane never
     # bleed state into each other.
     session: str = ""
+    # validator-set epoch this node was spawned under (lifecycle/epoch.py
+    # EpochManager). A registry rotation bumps the service-side epoch; the
+    # epoch joins every dedup key and trace span so a verdict computed
+    # against epoch E's registry is never replayed for epoch E+1's, and a
+    # traced run can attribute work to the validator set that served it.
+    # 0 = the single-epoch default (pre-lifecycle key shapes unchanged).
+    epoch: int = 0
 
     # -- TPU batch plane ---------------------------------------------------
     # max candidates per device verification launch

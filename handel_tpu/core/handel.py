@@ -216,8 +216,11 @@ class Handel:
         # without coordination; generated only while tracing, so untraced
         # packets stay span_id=0 (no trailer on the wire)
         self._span_seq = 0
-        # session tag folded into span args end to end (multi-tenant runs)
+        # session/epoch tags folded into span args end to end (multi-tenant
+        # runs; the epoch marks which validator set served this node)
         self._sargs = {"session": self.c.session} if self.c.session else {}
+        if self.c.epoch:
+            self._sargs = {**self._sargs, "epoch": self.c.epoch}
         # distributional measures (always on — a handful of clock reads per
         # level/batch): level-completion latency since start, for the
         # monitor plane's _p50/_p90/_p99 columns (sim/monitor.py)
@@ -275,6 +278,7 @@ class Handel:
             recorder=self.rec,
             trace_tid=self._tid,
             session=self.c.session,
+            epoch=self.c.epoch,
         )
         self.net.register_listener(self)
         self.timeout = (

@@ -224,6 +224,7 @@ class BatchProcessing:
         recorder=None,
         trace_tid: int = 0,
         session: str = "",
+        epoch: int = 0,
     ):
         self.part = part
         self.cons = constructor
@@ -248,6 +249,17 @@ class BatchProcessing:
         # hand one tenant another tenant's verdict. "" keeps the
         # single-tenant key shape byte-for-byte.
         self.session = session
+        # validator-set epoch (lifecycle/epoch.py): a nonzero epoch joins
+        # the dedup scope so verdicts never survive a registry rotation —
+        # the same bytes against a rotated validator set is a new fact.
+        self.epoch = epoch
+        # tenant/epoch tags folded into every queue/verify span (built once;
+        # the tracing hot path only splats the dict)
+        self._span_tags: dict = {}
+        if session:
+            self._span_tags["session"] = session
+        if epoch:
+            self._span_tags["epoch"] = epoch
         # verified-aggregate dedup: Handel re-receives the same winning
         # aggregate from several peers per level; each copy this node has
         # already judged short-circuits here instead of burning a device lane
@@ -437,11 +449,7 @@ class BatchProcessing:
                             "ind": sp.is_ind,
                             "tries": sp.verify_tries,
                             "span": sp.span_id,
-                            **(
-                                {"session": self.session}
-                                if self.session
-                                else {}
-                            ),
+                            **self._span_tags,
                         },
                     )
         # Dedup pass: a candidate whose exact content — (level, bitset words,
@@ -453,7 +461,16 @@ class BatchProcessing:
         first_at: dict[tuple, int] = {}
         to_verify: list[int] = []
         for i, sp in enumerate(batch):
-            scope = (self.session, sp.level) if self.session else sp.level
+            # scope: level alone (single-tenant default, key shape
+            # unchanged), else (session, level) or — post-rotation —
+            # (session, epoch, level), so an epoch bump invalidates every
+            # verdict computed against the previous validator set
+            if self.epoch:
+                scope = (self.session, self.epoch, sp.level)
+            elif self.session:
+                scope = (self.session, sp.level)
+            else:
+                scope = sp.level
             k = VerifiedAggCache.key(scope, sp.ms)
             keys.append(k)
             if k in first_at:
@@ -531,11 +548,7 @@ class BatchProcessing:
                         "ok": bool(ok) if ok is not None else None,
                         "batch": len(batch),
                         "span": sp.span_id,
-                        **(
-                            {"session": self.session}
-                            if self.session
-                            else {}
-                        ),
+                        **self._span_tags,
                     },
                 )
                 if sp.span_id:

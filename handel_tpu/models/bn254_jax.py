@@ -241,6 +241,14 @@ class BN254Device:
         self.host_pack_launches = 0
         self.host_dispatch_ms = 0.0
         self.host_dispatch_launches = 0
+        # epoch-based registry rotation (lifecycle/epoch.py): a second
+        # device-resident bank is staged via `stage_registry` while this
+        # one keeps serving; `activate_staged` is the pointer flip between
+        # launches. `epoch` counts flips — 0 is the construction-time set.
+        self.epoch = 0
+        self._staged: dict | None = None
+        self.registry_stagings = 0
+        self.registry_staged_ms = 0.0
 
     @property
     def _prefix(self):
@@ -258,7 +266,10 @@ class BN254Device:
             self._prefix_cache = self._build_prefix()
         return self._prefix_cache
 
-    def _build_prefix(self):
+    def _build_prefix(self, reg_x=None, reg_y=None):
+        """Prefix table over a registry bank (default: the active one).
+        `stage_registry` passes the STAGED bank so the scan runs off the
+        launch critical path."""
         g2 = self.curves.g2
 
         @jax.jit  # one executable for the whole scan + batch affine convert
@@ -267,13 +278,88 @@ class BN254Device:
             pref = g2.prefix_scan(P)  # inclusive prefix sums, projective
             return g2.to_affine(pref)
 
-        x, y, inf = build(self._reg_x, self._reg_y)
+        x, y, inf = build(
+            self._reg_x if reg_x is None else reg_x,
+            self._reg_y if reg_y is None else reg_y,
+        )
         pad = lambda a: jnp.pad(a, ((0, 0), (1, 0)))  # exclusive: slot 0 = O
         return (
             (pad(x[0]), pad(x[1])),
             (pad(y[0]), pad(y[1])),
             jnp.pad(inf, (1, 0), constant_values=True),
         )
+
+    # -- epoch-based registry rotation (lifecycle/epoch.py) ----------------
+
+    def stage_registry(
+        self, registry_pubkeys: Sequence[BN254PublicKey],
+        build_prefix: bool = True,
+    ) -> int:
+        """Stage the NEXT validator set as a second device-resident bank
+        while the active one keeps serving launches. Everything expensive —
+        the host f2 pack, the device_put, the prefix-table scan — happens
+        here, off the launch critical path; the later `activate_staged` is
+        a pointer flip between launches. Re-staging before activation
+        replaces the pending bank (last staging wins). Returns the staged
+        registry size."""
+        t0 = time.perf_counter()
+        T = self.curves.T
+        pts = [pk.point for pk in registry_pubkeys]
+        if any(p is None for p in pts):
+            raise ValueError("staged registry keys must be valid G2 points")
+        reg_x = self._dput(T.f2_pack([p[0] for p in pts]))
+        reg_y = self._dput(T.f2_pack([p[1] for p in pts]))
+        prefix = None
+        if build_prefix:
+            prefix = self._build_prefix(reg_x, reg_y)
+            # materialize NOW: the flip must never pay the scan
+            jax.block_until_ready(prefix[2])
+        else:
+            jax.block_until_ready(reg_y)
+        self._staged = {
+            "reg_x": reg_x, "reg_y": reg_y, "n": len(pts), "prefix": prefix,
+        }
+        self.registry_stagings += 1
+        self.registry_staged_ms += (time.perf_counter() - t0) * 1e3
+        return len(pts)
+
+    def activate_staged(self) -> int:
+        """Flip the staged bank live — the caller quiesces launches around
+        this (lifecycle/epoch.py EpochManager.commit). Cheap by
+        construction: pointer swaps, plus a staging-buffer realloc only
+        when the registry size changed. Returns the new epoch."""
+        st = self._staged
+        if st is None:
+            raise RuntimeError("no staged registry: call stage_registry first")
+        if self.mesh is not None:
+            if st["n"] != self.n:
+                # the sharded sum/check executables are specialized to the
+                # construction-time registry width; resizing would need a
+                # rebuild of the whole staged pipeline
+                raise RuntimeError(
+                    "mesh-sharded registry rotation requires an equal-size "
+                    f"validator set (active {self.n}, staged {st['n']})"
+                )
+            from handel_tpu.parallel.sharding import commit_registry_sharded
+
+            self._reg_sharded = commit_registry_sharded(
+                self.mesh, st["reg_x"], st["reg_y"], st["n"]
+            )
+        self._reg_x, self._reg_y = st["reg_x"], st["reg_y"]
+        self._prefix_cache = st["prefix"]
+        if st["n"] != self.n:
+            self.n = st["n"]
+            self._stage = [
+                _StagingSet(
+                    self.n, self.batch_size, self.MISS_CAP,
+                    self.curves.F.nlimbs,
+                )
+                for _ in range(self.stage_sets)
+            ]
+            self._stage_idx = 0
+        self._staged = None
+        self.epoch += 1
+        return self.epoch
 
     # -- the jitted batch kernels ------------------------------------------
 
@@ -347,31 +433,45 @@ class BN254Device:
         agg = g2.masked_sum(P2, mask, self.n)  # projective, batch C
         return self._pairing_tail(agg, sig_x, sig_y, h_x, h_y, valid)
 
-    def _gather_prefix(self, idx):
+    def _gather_prefix(self, prefix, idx):
         """(C,) int32 -> projective G2 batch from the prefix table."""
         g2 = self.curves.g2
-        (x0, x1), (y0, y1), inf = self._prefix
+        (x0, x1), (y0, y1), inf = prefix
         take = lambda a: jnp.take(a, idx, axis=1)
         P = g2.from_affine((take(x0), take(x1)), (take(y0), take(y1)))
         return g2.select(jnp.take(inf, idx), g2.infinity(idx.shape[0]), P)
 
-    def _range_aggregate(self, lo, hi, miss_idx, miss_ok, miss_k):
+    def _range_aggregate(
+        self, lo, hi, miss_idx, miss_ok, prefix, reg_x, reg_y, miss_k
+    ):
         """Per-candidate aggregate key (projective) =
-        prefix[hi] - prefix[lo] - sum(missing signers in the hull)."""
+        prefix[hi] - prefix[lo] - sum(missing signers in the hull).
+
+        prefix/reg_x/reg_y are jit ARGUMENTS, not closure reads: with the
+        bank traced as an input, the compiled executable is shape-keyed
+        only, so an epoch flip to an equal-size registry reuses it — no
+        retrace, no recompile inside the quiesce window. (Capturing
+        `self._reg_x` here would bake the construction-time bank in as a
+        compile-time constant and every flip would silently keep verifying
+        against the OLD validator set.)"""
         g2 = self.curves.g2
-        hull = g2.add(self._gather_prefix(hi), g2.neg(self._gather_prefix(lo)))
+        hull = g2.add(
+            self._gather_prefix(prefix, hi),
+            g2.neg(self._gather_prefix(prefix, lo)),
+        )
         if miss_k:
             take = lambda a: jnp.take(a, miss_idx, axis=1)
             Pm = g2.from_affine(
-                (take(self._reg_x[0]), take(self._reg_x[1])),
-                (take(self._reg_y[0]), take(self._reg_y[1])),
+                (take(reg_x[0]), take(reg_x[1])),
+                (take(reg_y[0]), take(reg_y[1])),
             )
             msum = g2.masked_sum(Pm, miss_ok, miss_k)
             hull = g2.add(hull, g2.neg(msum))
         return hull
 
     def _verify_batch_range(
-        self, lo, hi, miss_idx, miss_ok, sig_x, sig_y, h_x, h_y, valid, miss_k
+        self, lo, hi, miss_idx, miss_ok, sig_x, sig_y, h_x, h_y, valid,
+        prefix, reg_x, reg_y, miss_k,
     ):
         """Range-candidate launch: per-candidate aggregate key via the prefix
         table — the O(1)-per-candidate path for Handel traffic, where every
@@ -379,8 +479,12 @@ class BN254Device:
         (partitioner.go rangeLevel) minus a few offline members. lo/hi: (C,)
         indices into the prefix table; miss_idx/miss_ok: (miss_k*C,)
         block-major registry indices + validity for the subtraction patch.
+        prefix/reg_* are the active bank, passed as arguments (see
+        _range_aggregate for why).
         """
-        hull = self._range_aggregate(lo, hi, miss_idx, miss_ok, miss_k)
+        hull = self._range_aggregate(
+            lo, hi, miss_idx, miss_ok, prefix, reg_x, reg_y, miss_k
+        )
         return self._pairing_tail(hull, sig_x, sig_y, h_x, h_y, valid)
 
     # -- staged sharded pipeline (mesh_devices > 1) -------------------------
@@ -388,14 +492,27 @@ class BN254Device:
     def _range_agg_kernel(self, miss_k: int):
         """Range aggregation alone as its own executable: point adds only,
         no pairing — compiles in seconds and keeps the mesh out of the
-        monolithic jit."""
+        monolithic jit. The returned callable keeps the per-launch
+        (lo, hi, miss_idx, miss_ok) signature and injects the CURRENT
+        bank's prefix/registry as trailing jit arguments, so an epoch flip
+        reaches already-compiled kernels (and an equal-size flip reuses
+        the executable outright)."""
         _ = self._prefix
         fn = self._range_agg_kernels.get(miss_k)
         if fn is None:
-            fn = jax.jit(
+            jitted = jax.jit(
                 partial(self._range_aggregate, miss_k=miss_k),
+                # donate only the per-launch staging inputs; the bank args
+                # (4, 5, 6) are device residents and must survive launches
                 donate_argnums=(0, 1, 2, 3) if self._donate else (),
             )
+
+            def fn(lo, hi, miss_idx, miss_ok, _jitted=jitted):
+                return _jitted(
+                    lo, hi, miss_idx, miss_ok,
+                    self._prefix, self._reg_x, self._reg_y,
+                )
+
             self._range_agg_kernels[miss_k] = fn
         return fn
 
@@ -431,11 +548,24 @@ class BN254Device:
         fn = self._range_kernels.get(miss_k)
         if fn is None:
             # donate every per-launch staging input; h_x/h_y (args 6, 7) are
-            # the cached H(m) and must survive across launches
-            fn = jax.jit(
+            # the cached H(m) and the bank args (9, 10, 11) are the
+            # device-resident prefix/registry — all must survive launches
+            jitted = jax.jit(
                 partial(self._verify_batch_range, miss_k=miss_k),
                 donate_argnums=(0, 1, 2, 3, 4, 5, 8) if self._donate else (),
             )
+
+            # same bank-injection wrapper as _range_agg_kernel: callers keep
+            # the per-launch signature, epoch flips reach compiled kernels
+            def fn(
+                lo, hi, miss_idx, miss_ok, sig_x, sig_y, h_x, h_y, valid,
+                _jitted=jitted,
+            ):
+                return _jitted(
+                    lo, hi, miss_idx, miss_ok, sig_x, sig_y, h_x, h_y, valid,
+                    self._prefix, self._reg_x, self._reg_y,
+                )
+
             self._range_kernels[miss_k] = fn
         return fn
 

@@ -25,8 +25,13 @@ Design:
     tunneled chip pays a ~57-68 ms host<->device round trip per dispatch that
     dwarfs the kernel (a naive time-one-call loop reads 15.5M/s and is
     measuring the tunnel, not the VPU — see `_throughput_bench`). The
-    dispatch floor, not mul throughput, dominates the ~104 ms 128-lane
-    verify p50 (results/verify_profile.json breaks the launch down).
+    figure is batch-sensitive: the artifact's `mxu_lab` control reads 13.1M
+    at B=32768 on a capture-contended host — 1/8 the production batch fills
+    a fraction of the lanes/VMEM tiles, and contention inflates the slope;
+    the artifact's `note` walks all four figures (15.5M / 13.1M / 357M /
+    250-436M) back to one story. The dispatch floor, not mul throughput,
+    dominates the ~104 ms 128-lane verify p50 (results/verify_profile.json
+    breaks the launch down).
   * **Batch stacking beats vmap.** Callers (ops/tower.py) flatten independent
     field muls into the batch dimension (one Fp12 mul = ONE mont_mul call at
     54x batch), keeping lanes full even for small pairing batches.

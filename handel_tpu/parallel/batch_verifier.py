@@ -99,6 +99,7 @@ class BatchVerifierService:
         recorder=None,
         quantum: int = 8,
         max_pending_per_session: int = 4096,
+        queue_capacity: int = 0,
     ):
         if isinstance(device, DevicePlane):
             self.plane = device
@@ -141,13 +142,28 @@ class BatchVerifierService:
         # The per-tenant bound is the service-side admission control — a
         # refused push fails that request's future immediately and the
         # session's own pipeline absorbs it under its retry budget.
+        # `queue_capacity` > 0 arms SLO load shedding (service/fairness.py
+        # SloTier): global depth past a tier's shed_at fraction refuses
+        # that tier's new work at the door, bronze before gold
         self.queue = TenantQueue(
-            quantum=quantum, max_pending=max_pending_per_session
+            quantum=quantum, max_pending=max_pending_per_session,
+            capacity=queue_capacity,
         )
         self._kick = asyncio.Event()
         self._task: asyncio.Task | None = None
         self._lane_tasks: list[asyncio.Task] = []
         self._free: asyncio.Event | None = None
+        # lifecycle plane (handel_tpu/lifecycle/): the validator-set epoch
+        # joins every dedup key, so a verdict computed against epoch E's
+        # registry is never replayed after a rotation; `_gate` pauses the
+        # collector's intake during quiesce_and (set = running), and
+        # `_collector_busy` marks the collector mid-batch so the quiesce
+        # knows when it has parked at the gate.
+        self.epoch = 0
+        self._gate: asyncio.Event | None = None
+        self._collector_busy = False
+        self.quiesce_ct = 0
+        self.last_quiesce_stall_ms = 0.0
         # the batch held by the collector between queue.take() and lane
         # hand-off — outside the queue and every lane structure — so stop()
         # can fail its waiters too (ADVICE r5 #1). Batches held by lane
@@ -177,6 +193,8 @@ class BatchVerifierService:
     def start(self) -> None:
         loop = asyncio.get_running_loop()
         self._free = asyncio.Event()
+        self._gate = asyncio.Event()
+        self._gate.set()
         self._lane_tasks = []
         for lane in self.plane.lanes:
             # hand-off cell (collector -> lane dispatcher; capacity 1: a
@@ -188,13 +206,19 @@ class BatchVerifierService:
             # tunnel, results/verify_profile.json) amortizes across
             # concurrent launches instead of serializing with the chip
             # compute. maxsize bounds device-side queue depth PER LANE.
-            lane.q = asyncio.Queue(maxsize=1)
-            lane.fetch_q = asyncio.Queue(maxsize=self.max_inflight)
-            self._lane_tasks.append(
-                loop.create_task(self._lane_dispatcher(lane))
-            )
-            self._lane_tasks.append(loop.create_task(self._lane_fetcher(lane)))
+            self._wire_lane(loop, lane)
         self._task = loop.create_task(self._collector())
+
+    def _wire_lane(self, loop, lane: DeviceLane) -> None:
+        """Bind one lane's asyncio plumbing and spawn its task pair (used
+        by start() for the initial plane and attach_lane() for growth)."""
+        lane.q = asyncio.Queue(maxsize=1)
+        lane.fetch_q = asyncio.Queue(maxsize=self.max_inflight)
+        lane.tasks = (
+            loop.create_task(self._lane_dispatcher(lane)),
+            loop.create_task(self._lane_fetcher(lane)),
+        )
+        self._lane_tasks.extend(lane.tasks)
 
     def stop(self) -> None:
         """Cancel every pipeline stage and FAIL any unanswered waiters —
@@ -236,6 +260,7 @@ class BatchVerifierService:
             fail(lane.dispatching)
             fail(lane.fetching)
             lane.dispatching = lane.fetching = None
+            lane.tasks = ()
         fail(self._collector_held)
         self._collector_held = None
         fail(self.queue.drain())
@@ -294,8 +319,14 @@ class BatchVerifierService:
         futs = []
         for bs, sig in requests:
             # content digest, not raw words: one 65k-committee bitset is
-            # 4 KB of words and this cache holds thousands of entries
-            key = (scope, msg, VerifiedAggCache.content_digest(bs, sig))
+            # 4 KB of words and this cache holds thousands of entries. The
+            # epoch rides the key so a registry rotation invalidates every
+            # pre-rotation verdict without a cache sweep (scope stays the
+            # key head: drop_scope/forget_session match on it).
+            key = (
+                scope, self.epoch, msg,
+                VerifiedAggCache.content_digest(bs, sig),
+            )
             cached = self.cache.get(key)
             if cached is not None:
                 # some co-located node of this session already verified
@@ -373,6 +404,122 @@ class BatchVerifierService:
         self.tenant_dedup_hits.pop(session, None)
         return len(dropped)
 
+    # -- lifecycle plane (handel_tpu/lifecycle/) ---------------------------
+
+    def _plane_idle(self) -> bool:
+        """No launch anywhere between collector hand-off and verdict."""
+        if self._collector_busy or self._collector_held is not None:
+            return False
+        return not any(
+            l.dispatching is not None or l.fetching is not None
+            or (l.fetch_q is not None and l.fetch_q.qsize())
+            for l in self.plane.lanes
+        )
+
+    async def quiesce_and(self, fn: Callable[[], None]) -> float:
+        """Pause intake, wait until every in-flight launch has resolved,
+        run `fn` (e.g. flip every engine's staged registry bank), resume.
+        Queued work is NOT dropped — it waits in the tenant queue and
+        dispatches against the post-`fn` plane; nothing in flight is
+        interrupted, so zero futures drop. Returns the stall in seconds
+        (gate-closed wall — the launch gap an epoch swap costs)."""
+        if self._task is None:
+            fn()
+            return 0.0
+        t0 = trace_now()
+        self._gate.clear()
+        try:
+            while not self._plane_idle():
+                await asyncio.sleep(0.001)
+            fn()
+        finally:
+            self._gate.set()
+            self._kick.set()
+        stall = trace_now() - t0
+        self.quiesce_ct += 1
+        self.last_quiesce_stall_ms = stall * 1e3
+        if self.rec is not None:
+            self.rec.span(
+                "plane_quiesce", t0, t0 + stall, tid=SERVICE_TID,
+                cat="lifecycle", args={"stall_ms": round(stall * 1e3, 3)},
+            )
+        return stall
+
+    def attach_lane(self, engine, breaker: CircuitBreaker | None = None) -> DeviceLane:
+        """Grow the verify plane by one lane, live (LaneAutoscaler scale-up
+        or breaker-open replacement). When the service is running, the
+        lane's dispatcher/fetcher pair spawns immediately and the scheduler
+        can route to it from the next pick."""
+        lane = self.plane.add_lane(engine, breaker)
+        if self.rec is not None:
+            self.rec.name_thread(lane.trace_tid, f"device-lane-{lane.index}")
+            self.rec.instant(
+                "lane_attached", tid=SERVICE_TID, cat="lifecycle",
+                args={"lane": lane.index, "lanes": len(self.plane)},
+            )
+        if self._task is not None:
+            self._wire_lane(asyncio.get_running_loop(), lane)
+            self._free.set()  # a new free lane exists: wake the collector
+        return lane
+
+    async def drain_lane(
+        self, lane: DeviceLane, timeout_s: float = 30.0,
+    ) -> bool:
+        """Gracefully retire one lane: stop routing to it, let its
+        in-flight launches resolve, then cancel its task pair and drop it
+        from the plane. Returns False when the drain timed out (the lane's
+        remaining work was failed over and the lane removed anyway — a
+        wedged chip must not be immortal)."""
+        lane.draining = True
+        deadline = trace_now() + timeout_s
+        while (
+            lane.dispatching is not None or lane.fetching is not None
+            or (lane.fetch_q is not None and lane.fetch_q.qsize())
+        ):
+            if trace_now() >= deadline:
+                break
+            await asyncio.sleep(0.001)
+        clean = (
+            lane.dispatching is None and lane.fetching is None
+            and (lane.fetch_q is None or not lane.fetch_q.qsize())
+        )
+        for t in lane.tasks:
+            t.cancel()
+            try:
+                self._lane_tasks.remove(t)
+            except ValueError:
+                pass
+        # anything the timeout stranded goes to failover/failure so no
+        # caller awaits forever (the stop() contract, per lane)
+        leftovers: list = []
+        if lane.fetch_q is not None:
+            while True:
+                try:
+                    leftovers.extend(lane.fetch_q.get_nowait()[1])
+                except asyncio.QueueEmpty:
+                    break
+        if lane.dispatching is not None:
+            leftovers.extend(lane.dispatching)
+        if lane.fetching is not None:
+            leftovers.extend(lane.fetching)
+        lane.dispatching = lane.fetching = None
+        lane.q = lane.fetch_q = None
+        lane.tasks = ()
+        self.plane.remove_lane(lane)
+        if leftovers:
+            await self._failover(leftovers)
+        if self.rec is not None:
+            self.rec.instant(
+                "lane_drained", tid=SERVICE_TID, cat="lifecycle",
+                args={
+                    "lane": lane.index, "clean": clean,
+                    "lanes": len(self.plane),
+                },
+            )
+        if self._free is not None:
+            self._free.set()  # re-evaluate scheduling after the shrink
+        return clean
+
     @staticmethod
     def _chain(fut: asyncio.Future, primary: asyncio.Future) -> None:
         """Copy a resolved primary's outcome onto a coalesced duplicate."""
@@ -441,9 +588,16 @@ class BatchVerifierService:
 
     async def _collector(self) -> None:
         while True:
+            self._collector_busy = False
+            # quiesce gate (lifecycle/epoch.py): cleared while a registry
+            # flip needs the plane idle; intake parks here, the tenant
+            # queue keeps absorbing (and admission-bounding) arrivals
+            await self._gate.wait()
             if not len(self.queue):
                 self._kick.clear()
                 await self._kick.wait()
+                continue  # re-check the gate before touching the queue
+            self._collector_busy = True
             # brief accumulation window so co-located nodes (and sessions)
             # share the launch
             if len(self.queue) < self.device.batch_size:
@@ -766,6 +920,9 @@ class BatchVerifierService:
             "sessionsQueued": float(self.queue.tenants()),
             "verifierQueueDepth": float(len(self.queue)),
             "admissionRefused": float(self.queue.refused),
+            # SLO admission plane: tier-shed pushes + the shed fraction
+            "admissionShed": float(self.queue.shed),
+            "shedRate": self.queue.shed_rate(),
             # host cost of building device inputs (vectorized packer,
             # models/bn254_jax.py); 0 for device stubs without the counter.
             # The cumulative sums are counters; the *PerLaunch averages are
@@ -789,6 +946,10 @@ class BatchVerifierService:
             "deviceRetryCt": float(self.device_retries),
             "failoverBatches": float(self.failover_batches),
             "failoverCandidates": float(self.failover_candidates),
+            # lifecycle plane: validator-set epoch + quiesce accounting
+            "epoch": float(self.epoch),
+            "quiesceCt": float(self.quiesce_ct),
+            "lastQuiesceStallMs": self.last_quiesce_stall_ms,
             # fleet plane: lane count, admissible lanes, scheduler audit
             **self.plane.values(),
             # process-wide dedup plane (monitor keys: verifier_dedup*)
@@ -808,4 +969,7 @@ class BatchVerifierService:
             "hostDispatchMsPerLaunch",
             "devicesTotal",
             "devicesAvailable",
+            "epoch",
+            "lastQuiesceStallMs",
+            "shedRate",
         } | self.cache.gauge_keys()

@@ -50,7 +50,7 @@ class DeviceLane:
     __slots__ = (
         "index", "engine", "breaker", "q", "fetch_q", "dispatching",
         "fetching", "launches", "candidates", "fill_sum", "last_fill",
-        "retries", "fetched", "queued_ts",
+        "retries", "fetched", "queued_ts", "draining", "tasks",
     )
 
     def __init__(self, index: int, engine, breaker: CircuitBreaker | None = None):
@@ -70,6 +70,13 @@ class DeviceLane:
         # trace stamp: when the launch group currently in `q` was handed to
         # this lane (the launch_queued span's start, batch_verifier.py)
         self.queued_ts = 0.0
+        # elasticity (lifecycle/autoscaler.py): a draining lane finishes
+        # its in-flight launches but the scheduler stops routing to it —
+        # the graceful half of drain_lane/remove_lane
+        self.draining = False
+        # the lane's dispatcher/fetcher task pair while the service runs
+        # (BatchVerifierService start()/attach_lane(); drain cancels them)
+        self.tasks: tuple = ()
 
     @property
     def trace_tid(self) -> int:
@@ -135,6 +142,12 @@ class DevicePlane:
         ]
         self.sched_picks = 0
         self.idle_violations = 0
+        # elasticity counters (lifecycle/autoscaler.py) + a monotonically
+        # increasing index source so a replacement lane never reuses a
+        # retired lane's metrics row / trace thread
+        self._next_index = len(self.lanes)
+        self.lanes_added = 0
+        self.lanes_removed = 0
 
     def __len__(self) -> int:
         return len(self.lanes)
@@ -143,9 +156,29 @@ class DevicePlane:
     def batch_size(self) -> int:
         return self.lanes[0].engine.batch_size
 
+    def add_lane(self, engine, breaker: CircuitBreaker | None = None) -> DeviceLane:
+        """Grow the plane by one lane (verify-plane elasticity). The caller
+        (BatchVerifierService.attach_lane) wires the asyncio plumbing; a
+        bare plane user just gets a new schedulable lane."""
+        lane = DeviceLane(self._next_index, engine, breaker)
+        self._next_index += 1
+        self.lanes.append(lane)
+        self.lanes_added += 1
+        return lane
+
+    def remove_lane(self, lane: DeviceLane) -> None:
+        """Retire one lane. The last lane is irremovable — a plane with no
+        engine cannot serve, and `batch_size`/`device` aliases would
+        dangle."""
+        if len(self.lanes) <= 1:
+            raise ValueError("cannot remove the last lane of a DevicePlane")
+        self.lanes.remove(lane)
+        self.lanes_removed += 1
+
     def allowed(self) -> list[DeviceLane]:
-        """Lanes whose breaker currently admits launches."""
-        return [l for l in self.lanes if l.breaker.allow()]
+        """Lanes whose breaker currently admits launches (a draining lane
+        admits nothing — it only finishes what it already carries)."""
+        return [l for l in self.lanes if not l.draining and l.breaker.allow()]
 
     def pick(self) -> DeviceLane | None:
         """Least-loaded free admissible lane; None when none is free."""
@@ -192,6 +225,8 @@ class DevicePlane:
             "devicesAvailable": float(len(self.allowed())),
             "schedPicks": float(self.sched_picks),
             "schedIdleViolations": float(self.idle_violations),
+            "lanesAdded": float(self.lanes_added),
+            "lanesRemoved": float(self.lanes_removed),
         }
 
     def labeled_values(self) -> dict[str, dict[str, float]]:

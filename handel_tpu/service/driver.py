@@ -48,6 +48,26 @@ class HostDevice:
         self.batch_size = batch_size
         self.launch_ms = launch_ms
         self.dispatched = 0
+        # epoch-rotation protocol parity with BN254Device (lifecycle/
+        # epoch.py): host verification reads per-request pubkeys so there
+        # is no resident bank to flip, but the soak/CI path must exercise
+        # the same stage -> quiesce -> activate choreography end to end
+        self.epoch = 0
+        self._staged = None
+        self.registry_stagings = 0
+        self.registry_staged_ms = 0.0
+
+    def stage_registry(self, registry_pubkeys, build_prefix: bool = True) -> int:
+        self._staged = registry_pubkeys
+        self.registry_stagings += 1
+        return len(registry_pubkeys)
+
+    def activate_staged(self) -> int:
+        if self._staged is None:
+            raise RuntimeError("no staged registry: call stage_registry first")
+        self._staged = None
+        self.epoch += 1
+        return self.epoch
 
     def dispatch_multi(self, items):
         verdicts: list[bool] = [False] * len(items)
@@ -86,6 +106,8 @@ class MultiSessionCluster:
         session_ttl_s: float = 60.0,
         quantum: int = 8,
         max_pending_per_session: int = 4096,
+        queue_capacity: int = 0,
+        tier_cycle: tuple | list = (),
         max_delay_ms: float = 2.0,
         spawn_stagger_s: float = 0.0,
         metrics_port: int | None = None,
@@ -100,6 +122,9 @@ class MultiSessionCluster:
         self.spawn_stagger_s = spawn_stagger_s
         self.seed_base = seed_base
         self.config_tweak = config_tweak
+        # SLO tiers (service/fairness.py TIERS) dealt round-robin across
+        # the spawned sessions; empty = every tenant on the flat default
+        self.tier_cycle = tuple(tier_cycle)
         scheme = scheme or FakeScheme()
         if device is None:
             if devices > 1:
@@ -120,6 +145,7 @@ class MultiSessionCluster:
             max_delay_ms=max_delay_ms,
             quantum=quantum,
             max_pending_per_session=max_pending_per_session,
+            queue_capacity=queue_capacity,
             recorder=recorder,
         )
         # one shared ring across every session's nodes AND the verify
@@ -181,6 +207,9 @@ class MultiSessionCluster:
                 threshold=self.threshold,
                 seed=self.seed_base + i,
                 config_tweak=self.config_tweak,
+                tier=self.tier_cycle[i % len(self.tier_cycle)]
+                if self.tier_cycle
+                else None,
             )
             self.manager.start(s.sid)
             if self.spawn_stagger_s > 0:
@@ -212,6 +241,13 @@ class MultiSessionCluster:
             "coalesced_launches": int(sv["coalescedLaunches"]),
             "dedup_hit_rate": round(sv["dedupHitRate"], 4),
             "admission_refused": int(sv["admissionRefused"]),
+            # lifecycle plane: SLO shedding, epoch rotation, elasticity
+            "admission_shed": int(sv["admissionShed"]),
+            "shed_rate": round(sv["shedRate"], 4),
+            "epoch": int(sv["epoch"]),
+            "quiesce_ct": int(sv["quiesceCt"]),
+            "last_quiesce_stall_ms": round(sv["lastQuiesceStallMs"], 3),
+            "tier_quantiles": self.manager.tier_quantiles(),
             # fleet plane: per-device launch counts (multichip smoke
             # asserts every device dispatched) + the scheduler audit
             "devices": len(self.service.plane),
@@ -268,6 +304,8 @@ async def run_in_process(cfg, *, seed_base: int = 0,
         session_ttl_s=p.session_ttl_s,
         quantum=p.quantum,
         max_pending_per_session=p.max_pending_per_session,
+        queue_capacity=p.queue_capacity,
+        tier_cycle=[t.strip() for t in p.tiers.split(",") if t.strip()],
         spawn_stagger_s=p.spawn_stagger_ms / 1000.0,
         metrics_port=metrics_port,
         seed_base=seed_base,
@@ -299,6 +337,9 @@ def merge_summaries(parts: list[dict]) -> dict:
         "verifier_candidates": sum(p["verifier_candidates"] for p in parts),
         "coalesced_launches": sum(p["coalesced_launches"] for p in parts),
         "admission_refused": sum(p["admission_refused"] for p in parts),
+        "admission_shed": sum(p.get("admission_shed", 0) for p in parts),
+        # conservative: the worst worker's shed rate (exact needs raws)
+        "shed_rate": max((p.get("shed_rate", 0.0) for p in parts), default=0.0),
         # fleet plane: each worker owns its own device plane, so the rows
         # concatenate (older workers without the keys contribute nothing)
         "devices": sum(p.get("devices", 1) for p in parts),

@@ -18,6 +18,17 @@ refusal surfaces to that session's caller (the processing pipeline's
 retry/requeue budget) instead of growing host memory or the ring latency
 every other tenant pays.
 
+SLO-driven admission (lifecycle control plane, ISSUE 12c): each tenant
+carries an `SloTier` — a priority weight that scales its DRR quantum (a
+gold tenant earns `weight ×` lane credits per ring visit) plus a
+load-shedding threshold expressed as a fraction of the queue's GLOBAL
+`capacity`. When total depth crosses a tier's `shed_at` fraction, NEW work
+for that tier is refused at the door — bronze sheds first, gold last — so
+an overloaded plane spends its lanes meeting the strictest p99 targets
+instead of degrading everyone equally. The flat per-tenant `max_pending`
+bound stays as the fallback flood-defense knob; `capacity = 0` disables
+shedding entirely (the pre-SLO behavior, byte for byte).
+
 Single-threaded like the service it fronts (core/store.py module
 docstring): every caller runs on one asyncio loop, so no lock.
 """
@@ -25,10 +36,35 @@ docstring): every caller runs on one asyncio loop, so no lock.
 from __future__ import annotations
 
 from collections import deque
+from dataclasses import dataclass
 from typing import Iterator
 
 DEFAULT_QUANTUM = 8
 DEFAULT_MAX_PENDING = 4096
+
+
+@dataclass(frozen=True)
+class SloTier:
+    """One admission/priority class. `weight` multiplies the tenant's DRR
+    quantum; `p99_target_s` is the session-completion SLO the manager
+    reports against (service/session.py tier_quantiles); `shed_at` is the
+    fraction of queue capacity past which this tier's new work sheds."""
+
+    name: str
+    weight: int = 1
+    p99_target_s: float = 30.0
+    shed_at: float = 1.0
+
+
+#: the built-in tier ladder; tenants without an explicit tier ride
+#: "standard" (weight 1, shed only at full capacity — legacy behavior)
+TIERS = {
+    "gold": SloTier("gold", weight=4, p99_target_s=5.0, shed_at=0.98),
+    "silver": SloTier("silver", weight=2, p99_target_s=15.0, shed_at=0.85),
+    "bronze": SloTier("bronze", weight=1, p99_target_s=60.0, shed_at=0.60),
+    "standard": SloTier("standard", weight=1, p99_target_s=30.0, shed_at=1.0),
+}
+DEFAULT_TIER = TIERS["standard"]
 
 
 class TenantQueue:
@@ -38,24 +74,53 @@ class TenantQueue:
         self,
         quantum: int = DEFAULT_QUANTUM,
         max_pending: int = DEFAULT_MAX_PENDING,
+        capacity: int = 0,
     ):
         if quantum < 1:
             raise ValueError("quantum must be >= 1")
         if max_pending < 1:
             raise ValueError("max_pending must be >= 1")
+        if capacity < 0:
+            raise ValueError("capacity must be >= 0")
         self.quantum = quantum
         self.max_pending = max_pending
+        # global depth bound for SLO shedding; 0 = shedding off
+        self.capacity = capacity
         self._q: dict[str, deque] = {}
         self._ring: deque[str] = deque()  # tenants with queued work
         self._deficit: dict[str, int] = {}
+        self._tier: dict[str, SloTier] = {}
+        self._total = 0  # queued items across tenants (O(1) shed check)
         # reporter counters
         self.pushed = 0
         self.refused = 0
+        self.shed = 0
         self.taken = 0
 
+    def set_tier(self, tenant: str, tier: SloTier | str) -> SloTier:
+        """Pin one tenant's admission/priority class ("gold"/"silver"/
+        "bronze"/"standard", or a custom SloTier)."""
+        if isinstance(tier, str):
+            tier = TIERS[tier]
+        self._tier[tenant] = tier
+        return tier
+
+    def tier_of(self, tenant: str) -> SloTier:
+        return self._tier.get(tenant, DEFAULT_TIER)
+
+    def drop_tier(self, tenant: str) -> None:
+        self._tier.pop(tenant, None)
+
     def push(self, tenant: str, item) -> bool:
-        """Enqueue one item for `tenant`; False = over the per-tenant bound
-        (the item was NOT queued — the caller owns the refusal)."""
+        """Enqueue one item for `tenant`; False = refused (the item was
+        NOT queued — the caller owns the refusal). Two doors: the tier's
+        load-shed threshold against GLOBAL depth, then the flat per-tenant
+        bound."""
+        if self.capacity > 0:
+            tier = self.tier_of(tenant)
+            if self._total >= self.capacity * tier.shed_at:
+                self.shed += 1
+                return False
         q = self._q.get(tenant)
         if q is None:
             q = self._q[tenant] = deque()
@@ -65,8 +130,14 @@ class TenantQueue:
             self.refused += 1
             return False
         q.append(item)
+        self._total += 1
         self.pushed += 1
         return True
+
+    def shed_rate(self) -> float:
+        """Shed pushes over everything offered (the soak SLO metric)."""
+        offered = self.pushed + self.refused + self.shed
+        return self.shed / offered if offered else 0.0
 
     def take(self, lanes: int) -> list:
         """Dequeue up to `lanes` items across tenants, deficit-round-robin.
@@ -81,10 +152,15 @@ class TenantQueue:
             q = self._q[t]
             d = self._deficit[t]
             if d <= 0:
-                self._deficit[t] = d = self.quantum
+                # tier weight scales the per-visit credit: a gold tenant
+                # earns weight× lanes per ring pass (priority share)
+                self._deficit[t] = d = (
+                    self.quantum * self.tier_of(t).weight
+                )
             k = min(d, len(q), lanes)
             for _ in range(k):
                 out.append(q.popleft())
+            self._total -= k
             self._deficit[t] = d - k
             lanes -= k
             if not q:
@@ -102,9 +178,11 @@ class TenantQueue:
     def drop_tenant(self, tenant: str) -> list:
         """Remove one tenant's whole queue (session evict); returns the
         dropped items so the caller can fail their waiters."""
+        self.drop_tier(tenant)
         q = self._q.pop(tenant, None)
         if q is None:
             return []
+        self._total -= len(q)
         self._deficit.pop(tenant, None)
         try:
             self._ring.remove(tenant)

@@ -75,11 +75,15 @@ class Session:
         clock: Callable[[], float] = time.monotonic,
         config_tweak: Callable[[Config, int], None] | None = None,
         recorder=None,
+        epoch: int = 0,
     ):
         self.sid = sid
         self.n = n
         self.clock = clock
         self.ttl_s = ttl_s
+        # validator-set epoch this session was spawned under (lifecycle/
+        # epoch.py): rides every node Config into dedup keys + trace spans
+        self.epoch = epoch
         self.state = STATE_SPAWNED
         self.created_at = clock()
         self.started_at: float | None = None
@@ -101,6 +105,7 @@ class Session:
             # verifier, its share of the fairness queue and the service
             # dedup plane
             cfg.session = sid
+            cfg.epoch = epoch
             # shared flight recorder (core/trace.py): every node of every
             # session records into one ring, spans tagged by session above
             cfg.recorder = recorder
@@ -264,6 +269,12 @@ class SessionManager:
         # terminal records of evicted sessions: (sid, state, completion_s)
         self.retired: deque = deque(maxlen=retired_capacity)
         self.completion_s: list[float] = []  # every threshold-reached run
+        # lifecycle plane: the epoch new sessions spawn under (bumped by
+        # lifecycle/epoch.py EpochManager.commit) + per-tenant SLO tiers
+        # and their completion-latency buckets (service/fairness.py TIERS)
+        self.epoch = 0
+        self.tiers: dict[str, str] = {}
+        self.completion_by_tier: dict[str, list[float]] = {}
         self._seq = 0
         # reporter counters
         self.spawned_ct = 0
@@ -292,6 +303,7 @@ class SessionManager:
         seed: int | None = None,
         ttl_s: float | None = None,
         config_tweak=None,
+        tier: str | None = None,
     ) -> Session:
         if len(self.sessions) >= self.max_sessions:
             # cap pressure: finished sessions still held are reclaimable
@@ -321,7 +333,15 @@ class SessionManager:
             clock=self.clock,
             config_tweak=config_tweak,
             recorder=self.recorder,
+            epoch=self.epoch,
         )
+        if tier is not None:
+            # SLO class end to end: recorded here for the per-tier p99
+            # surface, pinned on the shared verifier's tenant queue for
+            # weighted DRR + load shedding (service/fairness.py)
+            self.tiers[sid] = tier
+            if self.service is not None:
+                self.service.queue.set_tier(sid, tier)
         self.sessions[sid] = s
         self.spawned_ct += 1
         return s
@@ -338,6 +358,11 @@ class SessionManager:
             done_in = s.completion_s()
             if done_in is not None:
                 self.completion_s.append(done_in)
+                tier = self.tiers.get(s.sid)
+                if tier is not None:
+                    self.completion_by_tier.setdefault(tier, []).append(
+                        done_in
+                    )
         else:
             self.expired_ct += 1
         self._forget_tenant(s.sid)
@@ -346,6 +371,9 @@ class SessionManager:
         if self.service is not None:
             self.service.forget_session(sid)
         self.scorers.drop(sid)
+        # tier mapping is per-live-session state (the per-tier completion
+        # buckets above already banked this session's latency)
+        self.tiers.pop(sid, None)
 
     def evict(self, sid: str) -> bool:
         """Remove a session at any state; a live one transitions to
@@ -388,6 +416,26 @@ class SessionManager:
 
     # -- reporting -----------------------------------------------------------
 
+    def tier_quantiles(self) -> dict[str, dict[str, float]]:
+        """Per-SLO-tier completion latency against its target
+        (service/fairness.py TIERS): the soak harness's "p99 held within
+        its tier" acceptance surface."""
+        from handel_tpu.service.fairness import DEFAULT_TIER, TIERS
+
+        out: dict[str, dict[str, float]] = {}
+        for tier, vals in self.completion_by_tier.items():
+            done = sorted(vals)
+            target = TIERS.get(tier, DEFAULT_TIER).p99_target_s
+            p99 = _quantile(done, 0.99)
+            out[tier] = {
+                "completed": float(len(done)),
+                "p50_s": _quantile(done, 0.50),
+                "p99_s": p99,
+                "target_s": target,
+                "met": 1.0 if p99 <= target else 0.0,
+            }
+        return out
+
     def values(self) -> dict[str, float]:
         done = sorted(self.completion_s)
         return {
@@ -400,6 +448,7 @@ class SessionManager:
             "admissionRefused": float(self.refused_ct),
             "sessionCompletionP50S": _quantile(done, 0.50),
             "sessionCompletionP99S": _quantile(done, 0.99),
+            "epoch": float(self.epoch),
         }
 
     def gauge_keys(self) -> set[str]:
@@ -408,6 +457,7 @@ class SessionManager:
             "sessionsHeld",
             "sessionCompletionP50S",
             "sessionCompletionP99S",
+            "epoch",
         }
 
     def labeled_values(self) -> dict[str, dict[str, float]]:

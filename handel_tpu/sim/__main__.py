@@ -8,6 +8,7 @@ Usage: python -m handel_tpu.sim --config sim.toml --workdir out/
        python -m handel_tpu.sim watch sim.toml      (live /metrics dashboard)
        python -m handel_tpu.sim serve sim.toml      (multi-session service)
        python -m handel_tpu.sim swarm sim.toml      (virtual-node swarm)
+       python -m handel_tpu.sim soak                (lifecycle soak proof)
 """
 
 from __future__ import annotations
@@ -48,6 +49,25 @@ def main() -> int:
         summary = asyncio.run(run_service(cfg, sargs.workdir, sargs.config))
         print(json.dumps(summary))
         return 0 if summary["ok"] else 1
+    if len(sys.argv) > 1 and sys.argv[1] == "soak":
+        # lifecycle soak subcommand (sim/soak.py): a continuously-loaded
+        # service run with a mid-run epoch swap and a forced lane loss —
+        # the production lifecycle plane's CI proof (handel_tpu/lifecycle/)
+        kap = argparse.ArgumentParser(prog="python -m handel_tpu.sim soak")
+        kap.add_argument("--config", default="", help="TOML with a [soak] section")
+        kap.add_argument("--workdir", default="soak_out")
+        kap.add_argument("--duration", type=float, default=0.0,
+                         help="override [soak] duration_s")
+        kargs = kap.parse_args(sys.argv[2:])
+        from handel_tpu.sim.config import SoakParams
+        from handel_tpu.sim.soak import run_soak
+
+        p = load_config(kargs.config).soak if kargs.config else SoakParams()
+        if kargs.duration > 0:
+            p.duration_s = kargs.duration
+        report = asyncio.run(run_soak(p, kargs.workdir))
+        print(json.dumps(report))
+        return 0 if report["ok"] else 1
     if len(sys.argv) > 1 and sys.argv[1] == "swarm":
         # virtual-node swarm subcommand (handel_tpu/swarm/driver.py): run
         # the [swarm] TOML section's N identities as cooperative vnodes

@@ -133,12 +133,41 @@ class ServiceParams:
     session_ttl_s: float = 60.0  # running session expiry deadline
     quantum: int = 8  # DRR lane credits per tenant ring visit
     max_pending_per_session: int = 4096  # per-tenant verifier queue bound
+    queue_capacity: int = 0  # global SLO shed bound (fairness.py); 0 -> off,
+    # leaving the flat per-session bound above as the only admission control
+    tiers: str = ""  # comma-separated SLO tier cycle assigned to sessions
+    # round-robin, e.g. "gold,bronze" (fairness.py TIERS); "" -> untiered
     batch_size: int = 0  # shared-launch lanes; 0 -> global batch_size
     spawn_stagger_ms: float = 0.0  # delay between session spawns
     period_ms: float = 10.0  # gossip period of the session nodes
 
     def enabled(self) -> bool:
         return self.sessions > 0
+
+
+@dataclass
+class SoakParams:
+    """`[soak]` section: the lifecycle soak harness (sim/soak.py,
+    `python -m handel_tpu.sim soak`). Defaults are the ~90 s CI shape:
+    sustained tiered load on a 2-lane host plane with a mid-run epoch swap
+    at 40% and a forced lane-0 loss at 60% of the run."""
+
+    duration_s: float = 90.0  # load window (drain tail rides on top)
+    nodes: int = 16  # Handel nodes per session
+    concurrency: int = 8  # sessions held live by the spawner
+    devices: int = 2  # starting verify-plane lanes
+    max_lanes: int = 4  # LaneAutoscaler ceiling
+    batch_size: int = 64  # shared-launch width
+    queue_capacity: int = 4096  # global SLO shed bound (fairness.py)
+    session_ttl_s: float = 60.0  # per-session expiry (an expiry = a drop)
+    tiers: str = "gold,silver,bronze,standard"  # round-robin SLO cycle
+    period_ms: float = 5.0  # session node gossip period
+    registry: int = 256  # rotated validator-set size (epoch swap payload)
+    swap_at_frac: float = 0.4  # epoch rotation point, fraction of duration
+    lane_loss_at_frac: float = 0.6  # forced lane-0 breaker-open point
+    control_interval_s: float = 0.25  # LifecycleController tick
+    autotune_every_s: float = 5.0  # critical-path recompute throttle
+    trace_capacity: int = 1 << 17  # flight-recorder ring (events)
 
 
 @dataclass
@@ -229,6 +258,8 @@ class SimConfig:
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
     # -- multi-tenant service (handel_tpu/service/; `sim serve`) -----------
     service: ServiceParams = field(default_factory=ServiceParams)
+    # -- lifecycle soak harness (sim/soak.py; `sim soak`) ------------------
+    soak: SoakParams = field(default_factory=SoakParams)
     # -- virtual-node swarm (handel_tpu/swarm/; `sim swarm`) ---------------
     swarm: SwarmParams = field(default_factory=SwarmParams)
     # -- remote platform (sim/remote.py; aws.go analog) --------------------
@@ -282,9 +313,30 @@ def load_config(path: str) -> SimConfig:
         session_ttl_s=float(sv.get("session_ttl_s", 60.0)),
         quantum=int(sv.get("quantum", 8)),
         max_pending_per_session=int(sv.get("max_pending_per_session", 4096)),
+        queue_capacity=int(sv.get("queue_capacity", 0)),
+        tiers=str(sv.get("tiers", "")),
         batch_size=int(sv.get("batch_size", 0)),
         spawn_stagger_ms=float(sv.get("spawn_stagger_ms", 0.0)),
         period_ms=float(sv.get("period_ms", 10.0)),
+    )
+    so = raw.get("soak", {})
+    cfg.soak = SoakParams(
+        duration_s=float(so.get("duration_s", 90.0)),
+        nodes=int(so.get("nodes", 16)),
+        concurrency=int(so.get("concurrency", 8)),
+        devices=int(so.get("devices", 2)),
+        max_lanes=int(so.get("max_lanes", 4)),
+        batch_size=int(so.get("batch_size", 64)),
+        queue_capacity=int(so.get("queue_capacity", 4096)),
+        session_ttl_s=float(so.get("session_ttl_s", 60.0)),
+        tiers=str(so.get("tiers", "gold,silver,bronze,standard")),
+        period_ms=float(so.get("period_ms", 5.0)),
+        registry=int(so.get("registry", 256)),
+        swap_at_frac=float(so.get("swap_at_frac", 0.4)),
+        lane_loss_at_frac=float(so.get("lane_loss_at_frac", 0.6)),
+        control_interval_s=float(so.get("control_interval_s", 0.25)),
+        autotune_every_s=float(so.get("autotune_every_s", 5.0)),
+        trace_capacity=int(so.get("trace_capacity", 1 << 17)),
     )
     sw = raw.get("swarm", {})
     cfg.swarm = SwarmParams(
@@ -388,9 +440,32 @@ def dump_config(cfg: SimConfig) -> str:
             f"session_ttl_s = {cfg.service.session_ttl_s}",
             f"quantum = {cfg.service.quantum}",
             f"max_pending_per_session = {cfg.service.max_pending_per_session}",
+            f"queue_capacity = {cfg.service.queue_capacity}",
+            f"tiers = {cfg.service.tiers!r}",
             f"batch_size = {cfg.service.batch_size}",
             f"spawn_stagger_ms = {cfg.service.spawn_stagger_ms}",
             f"period_ms = {cfg.service.period_ms}",
+        ]
+    if cfg.soak != SoakParams():  # non-default soak shapes round-trip
+        lines += [
+            "",
+            "[soak]",
+            f"duration_s = {cfg.soak.duration_s}",
+            f"nodes = {cfg.soak.nodes}",
+            f"concurrency = {cfg.soak.concurrency}",
+            f"devices = {cfg.soak.devices}",
+            f"max_lanes = {cfg.soak.max_lanes}",
+            f"batch_size = {cfg.soak.batch_size}",
+            f"queue_capacity = {cfg.soak.queue_capacity}",
+            f"session_ttl_s = {cfg.soak.session_ttl_s}",
+            f"tiers = {cfg.soak.tiers!r}",
+            f"period_ms = {cfg.soak.period_ms}",
+            f"registry = {cfg.soak.registry}",
+            f"swap_at_frac = {cfg.soak.swap_at_frac}",
+            f"lane_loss_at_frac = {cfg.soak.lane_loss_at_frac}",
+            f"control_interval_s = {cfg.soak.control_interval_s}",
+            f"autotune_every_s = {cfg.soak.autotune_every_s}",
+            f"trace_capacity = {cfg.soak.trace_capacity}",
         ]
     if cfg.swarm.enabled():
         lines += [

@@ -198,6 +198,18 @@ def aggregate(scrapes: list[dict]) -> dict:
         "net_dropped": total("handel_net_dropped_packets"),
         "verifier_launches": total("handel_device_verifier_verifier_launches"),
         "occupancy": mean("handel_device_verifier_verifier_occupancy"),
+        # lifecycle plane (handel_tpu/lifecycle/ via the verifier values()):
+        # registry epoch, plane-quiesce count + last gate-closed stall, SLO
+        # admission shedding, and autoscaler lane churn
+        "epoch": first("handel_device_verifier_epoch"),
+        "quiesce_ct": total("handel_device_verifier_quiesce_ct"),
+        "quiesce_stall_ms": first(
+            "handel_device_verifier_last_quiesce_stall_ms"
+        ),
+        "admission_shed": total("handel_device_verifier_admission_shed"),
+        "shed_rate": mean("handel_device_verifier_shed_rate"),
+        "lanes_added": total("handel_device_verifier_lanes_added"),
+        "lanes_removed": total("handel_device_verifier_lanes_removed"),
         # flight-recorder plane (core/trace.py values()): ring fill, drops
         # and the spans/s emit rate — the satellite-1 observability row
         "trace_events": total("handel_trace_trace_events"),
@@ -352,6 +364,19 @@ def render(model: dict, endpoints: list[str], up: int, tick: int) -> str:
     else:
         state = "no verifier plane"
     lines.append(f"breakers {state}")
+    if model.get("epoch") is not None:
+        sr = model.get("shed_rate")
+        stall = model.get("quiesce_stall_ms")
+        lines.append(
+            f"lifecycle epoch {_num(model['epoch'])}  "
+            f"quiesces {_num(model.get('quiesce_ct'))}"
+            f" (last stall "
+            f"{('--' if stall is None else f'{stall:.1f}ms')})  "
+            f"shed {_num(model.get('admission_shed'))} "
+            f"({('--' if sr is None else f'{sr:.1%}')})  "
+            f"lanes +{_num(model.get('lanes_added'))}"
+            f"/-{_num(model.get('lanes_removed'))}"
+        )
     lines.append(
         f"penalties reports {_num(model['penalty_reports'])}  "
         f"peers banned {_num(model['peers_banned'])}  "

@@ -68,6 +68,12 @@ SIDE_METRICS = {
     "swarm_identities": "higher",
     "mem_bytes_per_identity": "lower",
     "swarm_time_to_threshold_s": "lower",
+    # lifecycle soak (sim soak / scripts/soak_smoke.py): the epoch-swap
+    # gate-closed wall, tail session completion under the full drill
+    # (swap + forced lane loss), and the SLO admission shed fraction
+    "epoch_swap_stall_ms": "lower",
+    "soak_p99_s": "lower",
+    "shed_rate": "lower",
 }
 
 

@@ -151,6 +151,64 @@ def test_unpack_words_matches_host_mask(device):
         assert (got == want.T).all()
 
 
+def test_epoch_flip_reaches_compiled_kernels():
+    """Registry rotation vs the jitted-kernel cache: a kernel compiled
+    under epoch 0 must answer for the NEW bank after `activate_staged`.
+    The bank is a jit ARGUMENT (see _range_aggregate) — were it a closure
+    read, the cached executable would bake the old prefix/registry in as
+    compile-time constants and every post-flip launch would keep verifying
+    against the retired validator set. Also pins the flip's residency: the
+    staged bank was device_put at stage time, so the first post-flip
+    launch performs no implicit host→device transfer."""
+    rng = random.Random(31)
+
+    def mk(seed):
+        r = random.Random(seed)
+        sks = [r.randrange(1, 1 << 20) for _ in range(N)]
+        return [
+            BN254PublicKey(p) for p in nat.g2_mul_batch([bn.G2_GEN] * N, sks)
+        ]
+
+    pks_a, pks_b = mk(37), mk(41)
+    device = BN254Device(pks_a, batch_size=C)
+    reqs = _range_requests(rng)
+
+    def launch():
+        plan = device._pack_requests(reqs)
+        agg = device._range_agg_kernel(plan.miss_k)(
+            *device._stage_plan(plan)[:4]
+        )
+        jax.block_until_ready(agg)
+        return agg
+
+    def aggs(agg=None):
+        # the eager affine epilogue stays outside any transfer guard: it
+        # uploads Python scalar constants, which is fine off the hot path
+        agg = launch() if agg is None else agg
+        x, y, inf = device.curves.g2.to_affine(agg)
+        xs = device.curves.T.f2_unpack(x)
+        ys = device.curves.T.f2_unpack(y)
+        infs = np.asarray(inf)
+        return [
+            None if infs[j] else (xs[j], ys[j]) for j in range(len(reqs))
+        ]
+
+    assert all(
+        g == _host_agg(pks_a, bs) for g, (bs, _) in zip(aggs(), reqs)
+    )
+    device.stage_registry(pks_b)
+    # staged but not flipped: the compiled kernel still serves the old bank
+    assert all(
+        g == _host_agg(pks_a, bs) for g, (bs, _) in zip(aggs(), reqs)
+    )
+    assert device.activate_staged() == 1
+    with jax.transfer_guard_host_to_device("disallow"):
+        agg = launch()
+    assert all(
+        g == _host_agg(pks_b, bs) for g, (bs, _) in zip(aggs(agg), reqs)
+    )
+
+
 def test_combine_batch_matches_host(device):
     """combine_batch (one masked G1 tree-sum launch) equals the host
     pairing-library fold for random group shapes, including infinities,

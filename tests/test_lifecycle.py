@@ -1,0 +1,522 @@
+"""Production lifecycle control plane tests (handel_tpu/lifecycle/).
+
+Coverage per ISSUE 12: epoch registry rotation (stage/quiesce/flip with
+zero dropped futures, epoch-versioned dedup keys, session versioning),
+verify-plane elasticity (live attach, graceful drain, breaker-open
+replacement, depth/fill scaling with cooldown), SLO-driven admission
+(global shed bound, tier-weighted DRR, per-tier quantiles), critical-path
+autotuning (dominance hysteresis over stages_ms, clamps), and the
+controller loop tying them together. The full drill runs in CI as
+`scripts/soak_smoke.py`; these are the deterministic unit/integration
+pieces.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import pytest
+
+from handel_tpu.core.bitset import BitSet
+from handel_tpu.lifecycle import (
+    CriticalPathAutotuner,
+    EpochManager,
+    LaneAutoscaler,
+    LifecycleController,
+)
+from handel_tpu.parallel.batch_verifier import BatchVerifierService
+from handel_tpu.parallel.plane import DevicePlane
+from handel_tpu.service import SessionManager, TenantQueue
+from handel_tpu.service.driver import HostDevice
+from handel_tpu.service.fairness import TIERS, SloTier
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class _Sig:
+    def __init__(self, tag: int = 0):
+        self.tag = tag
+
+    def marshal(self) -> bytes:
+        return self.tag.to_bytes(4, "big")
+
+
+def _req(tag: int, n: int = 16):
+    bs = BitSet(n)
+    bs.set(tag % n, True)
+    return (bs, _Sig(tag))
+
+
+class StubEngine:
+    """dispatch_multi stub with the epoch-rotation protocol."""
+
+    def __init__(self, batch_size: int = 16, launch_s: float = 0.0):
+        self.batch_size = batch_size
+        self.launch_s = launch_s
+        self.dispatched = 0
+        self.epoch = 0
+        self._staged = None
+        self.fail = False
+
+    def stage_registry(self, registry_pubkeys, build_prefix: bool = True):
+        self._staged = list(registry_pubkeys)
+        return len(self._staged)
+
+    def activate_staged(self):
+        if self._staged is None:
+            raise RuntimeError("no staged registry")
+        self._staged = None
+        self.epoch += 1
+        return self.epoch
+
+    def dispatch_multi(self, items):
+        if self.fail:
+            raise RuntimeError("chip gone")
+        if self.launch_s:
+            time.sleep(self.launch_s)
+        self.dispatched += 1
+        return [True] * len(items)
+
+    def fetch(self, handle):
+        return handle
+
+
+# -- quiesce + epoch rotation -------------------------------------------------
+
+
+def test_quiesce_runs_fn_with_plane_idle():
+    async def go():
+        svc = BatchVerifierService(StubEngine(launch_s=0.01), max_delay_ms=0.2)
+        futs = [
+            asyncio.ensure_future(
+                svc.verify(b"m", [], [_req(i)], session="s")
+            )
+            for i in range(8)
+        ]
+        await asyncio.sleep(0.005)  # some launches in flight
+        seen = {}
+
+        def fn():
+            seen["idle"] = svc._plane_idle()
+
+        stall = await svc.quiesce_and(fn)
+        await asyncio.gather(*futs)
+        svc.stop()
+        return seen, stall, svc
+
+    seen, stall, svc = run(go())
+    assert seen["idle"] is True
+    assert stall >= 0.0
+    assert svc.quiesce_ct == 1
+    assert svc.values()["lastQuiesceStallMs"] == pytest.approx(stall * 1e3)
+
+
+def test_quiesce_before_start_runs_fn_directly():
+    async def go():
+        svc = BatchVerifierService(StubEngine())
+        called = []
+        stall = await svc.quiesce_and(lambda: called.append(1))
+        return called, stall
+
+    called, stall = run(go())
+    assert called == [1] and stall == 0.0
+
+
+def test_epoch_rotation_zero_drops_and_versioned_dedup():
+    """Work submitted before, during, and after a rotation all resolves;
+    the same aggregate re-verifies after the flip (epoch is in the dedup
+    key) instead of replaying the old epoch's verdict."""
+
+    async def go():
+        eng = StubEngine(launch_s=0.002)
+        svc = BatchVerifierService(eng, max_delay_ms=0.2)
+        mgr = SessionManager(service=svc, max_sessions=4)
+        em = EpochManager(svc, mgr)
+
+        before = [
+            asyncio.ensure_future(
+                svc.verify(b"m", [], [_req(i)], session="s")
+            )
+            for i in range(6)
+        ]
+        await asyncio.sleep(0.001)
+        dispatched_epoch0 = eng.dispatched
+        stall = await em.rotate([f"pk{i}" for i in range(8)])
+        after = [
+            asyncio.ensure_future(
+                svc.verify(b"m", [], [_req(i)], session="s")
+            )
+            for i in range(6)
+        ]
+        r_before = await asyncio.gather(*before)
+        r_after = await asyncio.gather(*after)
+        svc.stop()
+        return eng, svc, mgr, em, stall, dispatched_epoch0, r_before, r_after
+
+    eng, svc, mgr, em, stall, d0, r_before, r_after = run(go())
+    assert all(r == [True] for r in r_before + r_after)
+    assert svc.epoch == 1 and mgr.epoch == 1 and em.epoch == 1
+    assert eng.epoch == 1 and eng._staged is None
+    assert em.rotations == 1 and stall >= 0.0
+    # the identical aggregates re-dispatched under the new epoch: the old
+    # epoch's cached verdicts were NOT replayed across the flip
+    assert eng.dispatched > d0
+    vals = em.values()
+    assert vals["epochRotations"] == 1.0
+    assert vals["lastEpochSwapStallMs"] == pytest.approx(stall * 1e3)
+
+
+def test_commit_without_stage_raises():
+    async def go():
+        svc = BatchVerifierService(StubEngine())
+        em = EpochManager(svc)
+        with pytest.raises(RuntimeError, match="no staged rotation"):
+            await em.commit_rotation()
+
+    run(go())
+
+
+def test_sessions_spawn_under_current_epoch():
+    svc = BatchVerifierService(StubEngine())
+    mgr = SessionManager(service=svc, max_sessions=4)
+    mgr.epoch = 3
+    s = mgr.spawn(4)
+    assert s.epoch == 3
+    # the epoch rides every node Config into dedup keys + trace spans
+    assert all(h.c.epoch == 3 for h in s.cluster.handels.values())
+
+
+def test_host_device_epoch_protocol():
+    dev = HostDevice(None)
+    assert dev.stage_registry(["a", "b"]) == 2
+    assert dev.activate_staged() == 1
+    with pytest.raises(RuntimeError):
+        dev.activate_staged()
+
+
+# -- plane elasticity ---------------------------------------------------------
+
+
+def test_attach_lane_live_dispatches():
+    async def go():
+        # batch_size 2: 12 candidates split into 6 launch groups, so the
+        # least-loaded scheduler has real work to spread onto the new lane
+        svc = BatchVerifierService(
+            StubEngine(batch_size=2, launch_s=0.005), max_delay_ms=0.1
+        )
+        svc.start()
+        eng2 = StubEngine(batch_size=2, launch_s=0.005)
+        lane = svc.attach_lane(eng2)  # wired live, mid-service
+        futs = [
+            asyncio.ensure_future(
+                svc.verify(f"m{i}".encode(), [], [_req(i)], session="s")
+            )
+            for i in range(12)
+        ]
+        await asyncio.gather(*futs)
+        svc.stop()
+        return svc, lane, eng2
+
+    svc, lane, eng2 = run(go())
+    assert len(svc.plane) == 2 and lane.index == 1
+    assert eng2.dispatched > 0, "attached lane never dispatched"
+    assert svc.plane.values()["lanesAdded"] == 1.0
+
+
+def test_drain_lane_graceful_and_last_lane_protected():
+    async def go():
+        plane = DevicePlane([StubEngine(), StubEngine()])
+        svc = BatchVerifierService(plane, max_delay_ms=0.1)
+        await svc.verify(b"m", [], [_req(1)], session="s")
+        lane = svc.plane.lanes[1]
+        clean = await svc.drain_lane(lane)
+        # remaining work still verifies on the surviving lane
+        r = await svc.verify(b"m2", [], [_req(2)], session="s")
+        with pytest.raises(ValueError, match="last lane"):
+            svc.plane.remove_lane(svc.plane.lanes[0])
+        svc.stop()
+        return svc, clean, r
+
+    svc, clean, r = run(go())
+    assert clean is True and r == [True]
+    assert len(svc.plane) == 1
+    assert svc.plane.values()["lanesRemoved"] == 1.0
+
+
+def test_draining_lane_not_scheduled():
+    plane = DevicePlane([StubEngine(), StubEngine()])
+    plane.lanes[0].draining = True
+    assert plane.allowed() == [plane.lanes[1]]
+    assert plane.pick() is plane.lanes[1]
+
+
+def test_autoscaler_replaces_breaker_open_lane():
+    async def go():
+        plane = DevicePlane([StubEngine(), StubEngine()])
+        svc = BatchVerifierService(plane, max_delay_ms=0.1)
+        svc.start()
+        scaler = LaneAutoscaler(
+            svc, engine_factory=StubEngine, min_lanes=2, max_lanes=4
+        )
+        broken = svc.plane.lanes[0]
+        while broken.breaker.state != "open":
+            broken.breaker.record_failure()
+        out = await scaler.tick()
+        r = await svc.verify(b"m", [], [_req(1)], session="s")
+        svc.stop()
+        return svc, scaler, broken, out, r
+
+    svc, scaler, broken, out, r = run(go())
+    assert scaler.lanes_replaced == 1
+    assert broken not in svc.plane.lanes
+    assert len(svc.plane) == 2  # attach-first: never below the floor
+    assert r == [True]
+    assert any("replaced" in a for a in out["actions"])
+
+
+def test_autoscaler_grows_on_depth_and_respects_cooldown():
+    async def go():
+        svc = BatchVerifierService(StubEngine(), max_delay_ms=0.1)
+        svc.start()
+        now = [0.0]
+        scaler = LaneAutoscaler(
+            svc,
+            engine_factory=StubEngine,
+            min_lanes=1,
+            max_lanes=3,
+            scale_up_depth=1,
+            cooldown_s=10.0,
+            clock=lambda: now[0],
+        )
+        fut = asyncio.get_running_loop().create_future()
+        svc.queue.push("t", ("t", b"m", [], _req(1)[0], _req(1)[1], fut))
+        await scaler.tick()
+        lanes_after_first = len(svc.plane)
+        await scaler.tick()  # inside cooldown: no growth
+        lanes_after_second = len(svc.plane)
+        now[0] = 20.0
+        await scaler.tick()  # cooldown expired, depth still high
+        fut.cancel()
+        svc.queue.drop_tenant("t")
+        svc.stop()
+        return svc, scaler, lanes_after_first, lanes_after_second
+
+    svc, scaler, l1, l2 = run(go())
+    assert l1 == 2 and l2 == 2 and len(svc.plane) == 3
+    assert scaler.lanes_grown == 2
+
+
+def test_autoscaler_shrinks_idle_plane_to_floor():
+    async def go():
+        plane = DevicePlane([StubEngine(), StubEngine(), StubEngine()])
+        svc = BatchVerifierService(plane, max_delay_ms=0.1)
+        svc.start()
+        now = [0.0]
+        scaler = LaneAutoscaler(
+            svc,
+            engine_factory=StubEngine,
+            min_lanes=2,
+            max_lanes=4,
+            scale_down_depth=8,
+            cooldown_s=1.0,
+            clock=lambda: now[0],
+        )
+        now[0] = 2.0
+        await scaler.tick()  # idle + empty: shrink one
+        now[0] = 4.0
+        await scaler.tick()  # at the floor: hold
+        svc.stop()
+        return svc, scaler
+
+    svc, scaler = run(go())
+    assert len(svc.plane) == 2 and scaler.lanes_shrunk == 1
+
+
+# -- SLO admission ------------------------------------------------------------
+
+
+def _item(tag: int, tenant: str = "t"):
+    bs, sig = _req(tag)
+    return (tenant, b"m", [], bs, sig, None)
+
+
+def test_tenant_queue_sheds_at_capacity():
+    q = TenantQueue(quantum=4, max_pending=100, capacity=4)
+    for i in range(4):
+        assert q.push("a", _item(i))
+    assert not q.push("a", _item(9))  # at global capacity: shed
+    assert q.shed == 1 and q.shed_rate() == pytest.approx(1 / 5)
+    q.take(4)
+    assert q.push("a", _item(10))  # drained: admits again
+
+
+def test_tier_shed_ladder_bronze_before_gold():
+    q = TenantQueue(quantum=4, max_pending=100, capacity=10)
+    q.set_tier("b", "bronze")  # shed_at 0.60 -> refuses at depth 6
+    q.set_tier("g", "gold")  # shed_at 0.98 -> refuses at depth 9
+    for i in range(6):
+        assert q.push("g", _item(i))
+    assert not q.push("b", _item(100)), "bronze admitted past its shed point"
+    assert q.push("g", _item(101)), "gold shed too early"
+    assert q.shed == 1
+
+
+def test_tier_weight_scales_drr_quantum():
+    q = TenantQueue(quantum=2, max_pending=100)
+    q.set_tier("g", "gold")  # weight 4 -> 8 credits per visit
+    for i in range(8):
+        q.push("g", _item(i, "g"))
+        q.push("s", _item(100 + i, "s"))
+    batch = q.take(12)
+    by_tenant = {}
+    for it in batch:
+        by_tenant[it[0]] = by_tenant.get(it[0], 0) + 1
+    assert by_tenant["g"] == 8 and by_tenant["s"] == 4
+
+
+def test_drop_tenant_releases_tier_and_total():
+    q = TenantQueue(quantum=4, max_pending=100, capacity=8)
+    q.set_tier("a", "gold")
+    for i in range(8):
+        q.push("a", _item(i))
+    assert len(q.drop_tenant("a")) == 8
+    assert q.tier_of("a").name == "standard"
+    # global depth released: a full capacity's worth admits again
+    for i in range(8):
+        assert q.push("b", _item(i))
+
+
+def test_tier_registry_shapes():
+    assert set(TIERS) == {"gold", "silver", "bronze", "standard"}
+    assert TIERS["gold"].weight > TIERS["bronze"].weight
+    assert TIERS["gold"].p99_target_s < TIERS["bronze"].p99_target_s
+    assert isinstance(TIERS["gold"], SloTier)
+
+
+def test_manager_tier_quantiles_against_targets():
+    async def go():
+        svc = BatchVerifierService(StubEngine(32), max_delay_ms=0.2)
+        mgr = SessionManager(service=svc, max_sessions=8)
+        for i in range(2):
+            s = mgr.spawn(8, tier="gold")
+            mgr.start(s.sid)
+        await mgr.wait_all(20.0)
+        svc.stop()
+        return mgr
+
+    mgr = run(go())
+    tq = mgr.tier_quantiles()
+    assert tq["gold"]["completed"] == 2.0
+    assert 0 < tq["gold"]["p99_s"] <= tq["gold"]["target_s"]
+    assert tq["gold"]["met"] == 1.0
+    # tier mapping released at completion, latency bucket retained
+    assert mgr.tiers == {}
+
+
+# -- critical-path autotuning -------------------------------------------------
+
+
+def _report(**stages):
+    return {"stages_ms": stages}
+
+
+def test_autotuner_queue_dominance_shrinks_window():
+    svc = BatchVerifierService(StubEngine())
+    tuner = CriticalPathAutotuner(svc, patience=2)
+    d0 = svc.max_delay
+    assert tuner.observe(_report(queue=80.0, device=10.0, net=5.0)) == ""
+    assert svc.max_delay == d0  # hysteresis: one report is noise
+    action = tuner.observe(_report(queue=80.0, device=10.0, net=5.0))
+    assert "max_delay" in action and svc.max_delay < d0
+    assert tuner.adjustments == 1
+
+
+def test_autotuner_device_dominance_grows_window_with_clamp():
+    svc = BatchVerifierService(StubEngine())
+    tuner = CriticalPathAutotuner(svc, patience=1, max_delay_s=0.004)
+    for _ in range(20):
+        tuner.observe(_report(queue=5.0, device=90.0, net=5.0))
+    assert svc.max_delay == pytest.approx(0.004)  # clamped at the ceiling
+
+
+def test_autotuner_net_dominance_raises_inflight():
+    svc = BatchVerifierService(StubEngine())
+    tuner = CriticalPathAutotuner(svc, patience=1, max_inflight_cap=4)
+    base = svc.max_inflight
+    for _ in range(10):
+        tuner.observe(_report(queue=5.0, device=5.0, net=90.0))
+    assert svc.max_inflight == 4 > base
+
+
+def test_autotuner_streak_resets_on_stage_change():
+    svc = BatchVerifierService(StubEngine())
+    tuner = CriticalPathAutotuner(svc, patience=2)
+    tuner.observe(_report(queue=90.0, device=5.0))
+    tuner.observe(_report(device=90.0, queue=5.0))
+    tuner.observe(_report(queue=90.0, device=5.0))
+    assert tuner.adjustments == 0  # no stage held dominance twice running
+
+
+def test_autotuner_ignores_empty_and_unattributed_reports():
+    svc = BatchVerifierService(StubEngine())
+    tuner = CriticalPathAutotuner(svc, patience=1)
+    assert tuner.observe(None) == ""
+    assert tuner.observe({}) == ""
+    # verify/merge dominance is not actionable by the collector window
+    assert tuner.observe(_report(verify=95.0, queue=1.0, device=1.0)) == ""
+    assert svc.max_delay == 2.0 / 1e3 and tuner.adjustments == 0
+
+
+# -- controller ---------------------------------------------------------------
+
+
+def test_controller_ticks_compose_and_survive_bad_reports():
+    async def go():
+        svc = BatchVerifierService(StubEngine(), max_delay_ms=0.1)
+        svc.start()
+        scaler = LaneAutoscaler(svc, engine_factory=StubEngine, min_lanes=1)
+        calls = [0]
+
+        def bad_source():
+            calls[0] += 1
+            raise OSError("report missing")
+
+        ctl = LifecycleController(
+            svc,
+            autoscaler=scaler,
+            autotuner=CriticalPathAutotuner(svc),
+            epoch_manager=EpochManager(svc),
+            report_source=bad_source,
+            interval_s=0.01,
+        )
+        ctl.start()
+        with pytest.raises(RuntimeError, match="already started"):
+            ctl.start()
+        await asyncio.sleep(0.08)
+        await ctl.stop()
+        ticks = ctl.ticks
+        await ctl.stop()  # idempotent
+        svc.stop()
+        return ctl, ticks, calls[0]
+
+    ctl, ticks, calls = run(go())
+    assert ticks >= 3 and calls >= 3  # broken source never killed the loop
+    vals = ctl.values()
+    assert vals["lifecycleTicks"] == float(ticks)
+    # merged telemetry surface spans all three sub-planes
+    assert {"lanesReplaced", "autotuneAdjustments", "epochRotations"} <= set(
+        vals
+    )
+    assert "fillSignal" in ctl.gauge_keys()
+
+
+def test_service_values_carry_lifecycle_keys():
+    svc = BatchVerifierService(StubEngine(), queue_capacity=8)
+    vals = svc.values()
+    for key in ("epoch", "quiesceCt", "lastQuiesceStallMs", "shedRate",
+                "admissionShed"):
+        assert key in vals, key
+    assert {"epoch", "lastQuiesceStallMs", "shedRate"} <= svc.gauge_keys()

@@ -531,6 +531,49 @@ def test_bench_probe_short_circuit(monkeypatch):
     assert bench._probe_short_circuit() is None  # outage hook owns the path
 
 
+def test_bench_probe_verdict_cached_per_host(monkeypatch, tmp_path):
+    """An unreachable-backend verdict persists to the host-local cache, so
+    the ~8.5 min retry ladder replays once per TTL, not once per run
+    (BENCH_r05 tail). A reachable verdict never short-circuits (the tunnel
+    can drop between runs), and the forced-outage hook never writes the
+    cache (a test run must not poison real ones)."""
+    sys.path.insert(0, REPO)
+    import time as _time
+
+    import bench
+
+    cache = tmp_path / "probe_verdict.json"
+    monkeypatch.setenv("HANDEL_TPU_PROBE_CACHE", str(cache))
+    monkeypatch.delenv("HANDEL_TPU_BENCH_FORCE_PROBE_FAIL", raising=False)
+
+    assert bench._cached_probe_failure() is None  # no cache yet
+    bench._record_probe_verdict(False)
+    age = bench._cached_probe_failure()
+    assert age is not None and age < 60.0
+    # a fresh failure verdict short-circuits the whole ladder
+    monkeypatch.setenv("HANDEL_TPU_PROBE_BUDGET_S", "0.01")
+    assert bench._probe_with_retries() is False
+
+    bench._record_probe_verdict(True)
+    assert bench._cached_probe_failure() is None  # success never cached-skips
+
+    # stale failure verdict: re-probe (here the 0-budget ladder re-records)
+    cache.write_text(json.dumps(
+        {"reachable": False, "checked_at": _time.time() - 7200}
+    ))
+    assert bench._cached_probe_failure() is None
+
+    # the forced-outage hook returns False without touching the cache
+    cache.unlink()
+    monkeypatch.setenv("HANDEL_TPU_BENCH_FORCE_PROBE_FAIL", "1")
+    assert bench._probe_with_retries() is False
+    assert not cache.exists()
+
+    # corrupt cache is ignored, not fatal
+    cache.write_text("{nope")
+    assert bench._cached_probe_failure() is None
+
+
 def test_bench_check_dedupes_persisted_reemits():
     cap = "2026-01-01T00:00:00Z"
     recs = [

@@ -452,6 +452,65 @@ def test_admission_cap_refuses_then_evicts_finished():
     assert (s1.sid, STATE_DONE, s1.completion_s()) in list(mgr.retired)
 
 
+def test_evict_vs_threshold_same_tick_settles_once():
+    """The evict-vs-threshold race: evicting a session in the same event-loop
+    tick its threshold future resolves must settle the session exactly once
+    — never both completed AND evicted — with no late `_finish` after the
+    eviction, and must still `forget_session` the tenant's shared-plane
+    state. Deterministic via a hand-held completion future: the watcher is
+    parked on it, then resolution and eviction happen with no await between
+    them."""
+
+    async def go():
+        svc = BatchVerifierService(MultiStubDevice(32), max_delay_ms=0.2)
+        forgotten: list[str] = []
+        orig_forget = svc.forget_session
+        svc.forget_session = lambda sid: (forgotten.append(sid),
+                                          orig_forget(sid))[1]
+        mgr = SessionManager(service=svc, max_sessions=4)
+
+        # interleaving A: future resolves, evict lands BEFORE the watcher
+        # gets to run — the session must settle as evicted, not completed
+        s = mgr.spawn(4)
+        gate = asyncio.get_running_loop().create_future()
+        s.cluster.wait_complete_success = lambda ttl: gate
+        mgr.start(s.sid)
+        await asyncio.sleep(0)  # watcher parks on the gate
+        gate.set_result({})  # threshold reached...
+        assert mgr.evict(s.sid)  # ...and evicted, same tick, no await between
+        await asyncio.sleep(0.01)  # any stray watcher wakeup fires here
+
+        # interleaving B: the watcher settles DONE first, the evict of the
+        # still-held finished session lands in the same tick — terminal
+        # state must stick and the second tenant release must be idempotent
+        s2 = mgr.spawn(4)
+        gate2 = asyncio.get_running_loop().create_future()
+        s2.cluster.wait_complete_success = lambda ttl: gate2
+        mgr.start(s2.sid)
+        await asyncio.sleep(0)
+        gate2.set_result({})
+        await asyncio.sleep(0)  # watcher runs _finish(DONE)
+        assert s2.state == STATE_DONE
+        assert mgr.evict(s2.sid)  # held-but-finished: bookkeeping only
+        await asyncio.sleep(0.01)
+        svc.stop()
+        return mgr, s, s2, forgotten
+
+    mgr, s, s2, forgotten = run(go())
+    assert s.state == "evicted"
+    assert s2.state == STATE_DONE  # eviction never rewrites a terminal state
+    # each session settled exactly once: A evicted, B completed
+    assert mgr.evicted_ct == 1 and mgr.completed_ct == 1
+    assert mgr.expired_ct == 0
+    assert s.sid not in mgr.sessions and s2.sid not in mgr.sessions
+    # tenant state released for both (idempotent on B's double release)
+    assert forgotten.count(s.sid) == 1
+    assert forgotten.count(s2.sid) >= 1
+    assert s.sid not in mgr.tiers and s2.sid not in mgr.tiers
+    states = {sid: state for sid, state, _ in mgr.retired}
+    assert states[s.sid] == "evicted" and states[s2.sid] == STATE_DONE
+
+
 def test_evict_running_session():
     async def go():
         svc = BatchVerifierService(MultiStubDevice(32), max_delay_ms=0.2)
@@ -618,6 +677,26 @@ def test_service_toml_round_trip(tmp_path):
     assert not load_config(str(q)).service.enabled()
 
 
+def test_soak_toml_round_trip(tmp_path):
+    from handel_tpu.sim.config import SoakParams
+
+    cfg = SimConfig(
+        soak=SoakParams(
+            duration_s=12.0, nodes=8, concurrency=4, devices=3,
+            max_lanes=6, queue_capacity=512, tiers="gold,bronze",
+            swap_at_frac=0.3, lane_loss_at_frac=0.7,
+        ),
+    )
+    p = tmp_path / "soak.toml"
+    p.write_text(dump_config(cfg))
+    assert load_config(str(p)).soak == cfg.soak
+    # a default config dumps no [soak] table and loads back to defaults
+    q = tmp_path / "plain.toml"
+    q.write_text(dump_config(SimConfig()))
+    assert "[soak]" not in q.read_text()
+    assert load_config(str(q)).soak == SoakParams()
+
+
 # -- sim watch session rows ---------------------------------------------------
 
 
@@ -651,3 +730,34 @@ def test_watch_renders_session_rows():
     assert "running" in frame and "done" in frame
     # top-K orders by pending: the hot session leads
     assert frame.index("s1") < frame.index("s2")
+
+
+def test_watch_renders_lifecycle_row():
+    from handel_tpu.sim.watch_cli import aggregate, render
+
+    text = "\n".join(
+        [
+            "# TYPE handel_device_verifier_epoch gauge",
+            "handel_device_verifier_epoch 2",
+            "# TYPE handel_device_verifier_quiesce_ct counter",
+            "handel_device_verifier_quiesce_ct 2",
+            "# TYPE handel_device_verifier_last_quiesce_stall_ms gauge",
+            "handel_device_verifier_last_quiesce_stall_ms 65.2",
+            "# TYPE handel_device_verifier_admission_shed counter",
+            "handel_device_verifier_admission_shed 12",
+            "# TYPE handel_device_verifier_shed_rate gauge",
+            "handel_device_verifier_shed_rate 0.03",
+            "# TYPE handel_device_verifier_lanes_added counter",
+            "handel_device_verifier_lanes_added 3",
+            "# TYPE handel_device_verifier_lanes_removed counter",
+            "handel_device_verifier_lanes_removed 1",
+        ]
+    )
+    model = aggregate([parse_exposition(text)])
+    assert model["epoch"] == 2.0 and model["shed_rate"] == 0.03
+    frame = render(model, ["x"], 1, 1)
+    assert "lifecycle epoch 2" in frame
+    assert "65.2ms" in frame and "lanes +3/-1" in frame
+    # no lifecycle plane scraped -> the row stays absent entirely
+    bare = aggregate([parse_exposition("")])
+    assert "lifecycle" not in render(bare, ["x"], 1, 1)
