@@ -109,6 +109,23 @@ class Config:
     # 0 = the single-epoch default (pre-lifecycle key shapes unchanged).
     epoch: int = 0
 
+    # -- WAN scenario plane (handel_tpu/scenario/) -------------------------
+    # region label this node aggregates from (GeoNetwork planet model). Tags
+    # every send/recv/verify/merge trace span beside session/epoch so the
+    # critical-path analyzer can attribute WAN hops by region pair.
+    # "" = untagged (span args unchanged).
+    region: str = ""
+    # per-identity stake weights, indexed by identity id (any array-like the
+    # bitset's weight_sum can dot against — ArrayRegistry.weights()). None
+    # keeps the count-based threshold; all-1.0 weights are bit-for-bit
+    # equivalent to counting.
+    weights: Optional[object] = None
+    # minimum weight sum in an output multisignature; only read when
+    # `weights` is set. 0.0 = derive from `contributions` as the same
+    # fraction of total weight that `contributions` is of the node count
+    # (so a 51% count threshold becomes a 51% stake threshold).
+    weight_threshold: float = 0.0
+
     # -- TPU batch plane ---------------------------------------------------
     # max candidates per device verification launch
     batch_size: int = DEFAULT_BATCH_SIZE

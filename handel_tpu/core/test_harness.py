@@ -25,6 +25,7 @@ from handel_tpu.core.identity import ArrayRegistry, Identity
 from handel_tpu.core.net import Listener, Packet
 from handel_tpu.core.timeout import InfiniteTimeout
 from handel_tpu.network.chaos import ChaosConfig, ChaosNetwork
+from handel_tpu.network.geo import GeoConfig, GeoNetwork
 
 
 class InProcessRouter:
@@ -95,10 +96,12 @@ class LocalCluster:
         seed: int = 1,
         loss_rate: float = 0.0,
         chaos: ChaosConfig | None = None,
+        geo: GeoConfig | None = None,
         adversaries: dict[int, str] | None = None,
         recorder=None,
         metrics_port: int | None = None,
         verifier_service=None,
+        churn_after_s: float = 0.5,
     ):
         self.n = n
         self.scheme = scheme or FakeScheme()
@@ -122,7 +125,9 @@ class LocalCluster:
 
         self.handels: dict[int, Handel] = {}
         self.adversaries: dict[int, Handel] = {}
-        has_byzantine = bool(self.offline or self.roles or chaos)
+        # geo delays are not failures, but they do defer deliveries past
+        # the no-timeout harness's patience — keep real timeouts on
+        has_byzantine = bool(self.offline or self.roles or chaos or geo)
         for i in range(n):
             if i in self.offline:
                 continue  # offline nodes are simply never built (test.go:105-113)
@@ -140,7 +145,19 @@ class LocalCluster:
                 # (handel_test.go:99-101, 442-455)
                 cfg.new_timeout = InfiniteTimeout
             net = InProcessNetwork(self.router, f"inproc-{i}")
-            if chaos is not None and chaos.any():
+            if geo is not None:
+                # geo-latency planet model (network/geo.py): region-pair
+                # WAN delay, chaos faults composed on top when given
+                net = GeoNetwork(
+                    net,
+                    geo.for_node(i),
+                    chaos=chaos.for_node(i)
+                    if chaos is not None and chaos.any()
+                    else None,
+                )
+                if not cfg.region:
+                    cfg.region = geo.region_of(i)
+            elif chaos is not None and chaos.any():
                 net = ChaosNetwork(net, chaos.for_node(i))
             if i in self.roles:
                 from handel_tpu.sim.adversary import build_adversary
@@ -154,6 +171,7 @@ class LocalCluster:
                     self.msg,
                     secrets[i],
                     cfg,
+                    leave_after_s=churn_after_s,
                 )
                 continue
             own_sig = secrets[i].sign(self.msg)
@@ -161,6 +179,27 @@ class LocalCluster:
                 net, self.registry, idents[i], cons, self.msg, own_sig, cfg
             )
         self.threshold = next(iter(self.handels.values())).threshold
+
+        # churn (sim/adversary.py Churner): a departing node broadcasts
+        # Handel.mark_departed to every co-resident peer, so survivors
+        # re-level and re-evaluate threshold reachability immediately
+        churners = [
+            a for a in self.adversaries.values()
+            if getattr(a, "role", None) == "churner"
+        ]
+        if churners:
+            peers = list(self.handels.values()) + list(
+                self.adversaries.values()
+            )
+
+            def _on_depart(departed_id: int, _peers=peers) -> None:
+                for p in _peers:
+                    md = getattr(p, "mark_departed", None)
+                    if md is not None:
+                        md(departed_id)
+
+            for c in churners:
+                c.on_depart = _on_depart
 
         # live telemetry (core/metrics.py): one registry + HTTP endpoint for
         # the whole in-process cluster, every node's planes under a `node`

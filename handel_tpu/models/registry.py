@@ -30,6 +30,12 @@ def _bn254_jax(**kw):
     return BN254JaxScheme(**kw)
 
 
+def _eddsa(**kw):
+    from handel_tpu.models.eddsa import EdDSAScheme
+
+    return EdDSAScheme()
+
+
 def _bls12_381(**kw):
     from handel_tpu.models.bls12_381 import BLS12381Scheme
 
@@ -52,6 +58,8 @@ _TABLE = {
     "bn254-jax": (True, _bn254_jax),
     "bn254-tpu": (True, _bn254_jax),
     "bn256-tpu": (True, _bn254_jax),
+    "eddsa": (False, _eddsa),
+    "ed25519": (False, _eddsa),
     "bls12-381": (False, _bls12_381),
     "bls12381": (False, _bls12_381),
     "bls12-381-jax": (True, _bls12_381_jax),
@@ -59,7 +67,7 @@ _TABLE = {
     "bls12381-jax": (True, _bls12_381_jax),
 }
 
-SCHEMES = ("fake", "bn254", "bn254-jax", "bls12-381", "bls12-381-jax")
+SCHEMES = ("fake", "bn254", "bn254-jax", "eddsa", "bls12-381", "bls12-381-jax")
 
 
 def new_scheme(name: str, **kwargs):

@@ -37,6 +37,7 @@ from typing import Sequence
 from handel_tpu.core.identity import Identity
 from handel_tpu.core.logging import DEFAULT_LOGGER, Logger
 from handel_tpu.core.net import Listener, Packet
+from handel_tpu.core.trace import LogHistogram
 
 # how long a reordered (held-back) packet may wait for the next send to its
 # link before a timer flushes it anyway
@@ -107,6 +108,12 @@ class ChaosNetwork:
         self.duplicated = 0
         self.delayed = 0
         self.reordered = 0
+        # sampled-delay distribution (ms): delays were the one effect class
+        # with no counter beyond a count — the histogram puts the injected
+        # latency on the monitor plane (`net_delayMs_p50/_p90/_p99` CSV
+        # columns, `sim watch`, trace reports). GeoNetwork records its
+        # per-link WAN delays into the same histogram.
+        self.hist_delay = LogHistogram()
 
     # -- lifecycle / listener passthrough -----------------------------------
 
@@ -168,7 +175,9 @@ class ChaosNetwork:
                         -cfg.delay_jitter_ms, cfg.delay_jitter_ms
                     )
                 self.delayed += 1
-                self._later(max(0.0, delay_ms) / 1000.0, ident, packet)
+                delay_ms = max(0.0, delay_ms)
+                self.hist_delay.add(delay_ms)
+                self._later(delay_ms / 1000.0, ident, packet)
                 continue
             self._deliver(ident, packet)
             # a prior held-back packet is released AFTER this newer one:
@@ -238,4 +247,12 @@ class ChaosNetwork:
         }
         if hasattr(self.inner, "values"):
             out.update(self.inner.values())
+        return out
+
+    def histograms(self) -> dict[str, LogHistogram]:
+        """Distribution measures for the monitor's histogram plane
+        (sim/monitor.py HistogramIO under the `net` plane name)."""
+        out = {"delayMs": self.hist_delay}
+        if hasattr(self.inner, "histograms"):
+            out.update(self.inner.histograms())
         return out

@@ -1,0 +1,22 @@
+"""WAN scenario engine: geo-latency planets, churn, stake weights.
+
+`python -m handel_tpu.sim scenario --config <toml>` runs one; `confgen
+--scenario geo|churn|weighted` emits ready-to-run TOMLs (sim/confgen.py).
+"""
+
+from handel_tpu.scenario.engine import run_scenario, run_scenario_sync
+from handel_tpu.scenario.membership import MembershipEvent, MembershipSchedule
+from handel_tpu.scenario.planets import PLANETS, planet_names, planet_preset
+from handel_tpu.scenario.weights import PROFILES, make_weights
+
+__all__ = [
+    "run_scenario",
+    "run_scenario_sync",
+    "MembershipEvent",
+    "MembershipSchedule",
+    "PLANETS",
+    "planet_names",
+    "planet_preset",
+    "PROFILES",
+    "make_weights",
+]

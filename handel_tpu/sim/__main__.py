@@ -9,6 +9,8 @@ Usage: python -m handel_tpu.sim --config sim.toml --workdir out/
        python -m handel_tpu.sim serve sim.toml      (multi-session service)
        python -m handel_tpu.sim swarm sim.toml      (virtual-node swarm)
        python -m handel_tpu.sim soak                (lifecycle soak proof)
+       python -m handel_tpu.sim scenario --config s.toml   (WAN scenario)
+       python -m handel_tpu.sim confgen --scenario geo     (emit TOMLs)
 """
 
 from __future__ import annotations
@@ -82,6 +84,44 @@ def main() -> int:
         summary = asyncio.run(run_swarm(cfg, wargs.workdir, wargs.config))
         print(json.dumps(summary))
         return 0 if summary["ok"] else 1
+    if len(sys.argv) > 1 and sys.argv[1] == "scenario":
+        # WAN scenario subcommand (handel_tpu/scenario/engine.py): run the
+        # [scenario] TOML section's composed geo/churn/weights run in one
+        # process and write the bench-shaped report + trace into --workdir
+        zap = argparse.ArgumentParser(
+            prog="python -m handel_tpu.sim scenario"
+        )
+        zap.add_argument("--config", required=True,
+                         help="TOML with a [scenario] section")
+        zap.add_argument("--workdir", default="scenario_out")
+        zargs = zap.parse_args(sys.argv[2:])
+        import os
+
+        from handel_tpu.scenario import run_scenario
+
+        cfg = load_config(zargs.config)
+        os.makedirs(zargs.workdir, exist_ok=True)
+        report = asyncio.run(run_scenario(cfg, zargs.workdir))
+        print(json.dumps(report))
+        return 0 if report["ok"] else 1
+    if len(sys.argv) > 1 and sys.argv[1] == "confgen":
+        # experiment-matrix generator (sim/confgen.py): emit ready-to-run
+        # TOMLs; --scenario narrows to named entries (geo, churn,
+        # weighted, geo_weighted, node_count, ...), default = all
+        gap = argparse.ArgumentParser(
+            prog="python -m handel_tpu.sim confgen"
+        )
+        gap.add_argument(
+            "--scenario", action="append", default=None,
+            help="scenario name (repeatable); omit for the full matrix",
+        )
+        gap.add_argument("--outdir", default="configs")
+        gargs = gap.parse_args(sys.argv[2:])
+        from handel_tpu.sim.confgen import generate
+
+        for p in generate(gargs.outdir, gargs.scenario):
+            print(p)
+        return 0
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", required=True)
     ap.add_argument("--workdir", default="sim_out")

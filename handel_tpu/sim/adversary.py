@@ -17,6 +17,13 @@ These roles actively misbehave, each aimed at one hardening layer:
                    with random signature bytes, each content-distinct, so
                    only the bounded pending queue (BatchProcessing
                    max_pending) and the ban threshold stop the growth.
+  churner          dynamic membership (scenario engine): participates
+                   HONESTLY until `leave_after_s`, then departs — stops
+                   gossiping and fires `on_depart(node_id)` so the harness
+                   can broadcast Handel.mark_departed to survivors, who
+                   re-level around the hole and re-evaluate threshold
+                   reachability. Not byzantine, but seated by the same
+                   deterministic role machinery.
 
 Role assignment (`adversary_roles`) is deterministic from the run config so
 every node process computes the same mapping independently: adversaries take
@@ -35,7 +42,8 @@ from handel_tpu.core.net import Packet
 ROLE_INVALID_SIGNER = "invalid_signer"
 ROLE_STALE_REPLAYER = "stale_replayer"
 ROLE_FLOODER = "flooder"
-ROLES = (ROLE_INVALID_SIGNER, ROLE_STALE_REPLAYER, ROLE_FLOODER)
+ROLE_CHURNER = "churner"
+ROLES = (ROLE_INVALID_SIGNER, ROLE_STALE_REPLAYER, ROLE_FLOODER, ROLE_CHURNER)
 
 
 def forged_signature(sk, msg: bytes):
@@ -70,18 +78,51 @@ def adversary_roles(
 
 
 def check_threshold_reachable(
-    threshold: int, total: int, failing: int, roles: dict[int, str]
+    threshold: int,
+    total: int,
+    failing: int,
+    roles: dict[int, str],
+    *,
+    weights=None,
+    weight_threshold: float = 0.0,
+    departed: frozenset[int] | set[int] = frozenset(),
 ) -> None:
     """Fail fast when the run can never complete: invalid signers contribute
-    nothing countable (their signatures are rejected), so the honest supply
-    is total - failing - invalid_signers."""
-    invalid = sum(1 for r in roles.values() if r == ROLE_INVALID_SIGNER)
-    reachable = total - failing - invalid
-    if threshold > reachable:
+    nothing countable (their signatures are rejected), churners and already-
+    departed identities may leave before contributing, so the guaranteed
+    honest supply is total - failing - invalid - churners - departed.
+
+    With per-identity `weights` (indexed by node id) the check is on stake:
+    the reachable weight is the surviving cohort's total minus the WORST
+    CASE placement of the `failing` silent nodes — the heaviest survivors.
+    `weight_threshold` 0.0 derives the same stake fraction the count
+    threshold is of the node count."""
+    gone = {
+        i
+        for i, r in roles.items()
+        if r in (ROLE_INVALID_SIGNER, ROLE_CHURNER)
+    }
+    gone |= set(departed)
+    if weights is None:
+        reachable = total - failing - len(gone)
+        if threshold > reachable:
+            raise ValueError(
+                f"threshold {threshold} unreachable: only {reachable} honest "
+                f"contributions exist ({total} nodes - {failing} failing - "
+                f"{len(gone)} invalid/departing)"
+            )
+        return
+    w = [float(weights[i]) for i in range(total)]
+    remaining = sorted((w[i] for i in range(total) if i not in gone),
+                       reverse=True)
+    lost_to_failing = sum(remaining[:failing]) if failing > 0 else 0.0
+    reachable_w = sum(remaining) - lost_to_failing
+    want = weight_threshold or (threshold * sum(w) / total)
+    if want > reachable_w + 1e-9:
         raise ValueError(
-            f"threshold {threshold} unreachable: only {reachable} honest "
-            f"contributions exist ({total} nodes - {failing} failing - "
-            f"{invalid} invalid signers)"
+            f"weighted threshold {want:.3f} unreachable: at most "
+            f"{reachable_w:.3f} stake can contribute ({total} nodes, "
+            f"{failing} failing worst-case, {len(gone)} invalid/departing)"
         )
 
 
@@ -193,10 +234,52 @@ class Flooder(Handel):
         return {**super().values(), "advFloodedCt": float(self.flooded_ct)}
 
 
+class Churner(Handel):
+    """Honest until `leave_after_s`, then gone: cancels its own gossip and
+    fires `on_depart(node_id)` (set post-construction by the harness) so
+    survivors can `mark_departed` and re-level. The contribution it made
+    BEFORE leaving stays valid in any aggregate that already merged it —
+    departure removes future supply, not recorded history."""
+
+    role = ROLE_CHURNER
+
+    def __init__(self, *args, leave_after_s: float = 0.5, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.leave_after_s = leave_after_s
+        self.on_depart = None  # callable(node_id), wired by the harness
+        self.left = False
+        self._leave_handle: asyncio.TimerHandle | None = None
+
+    def start(self) -> None:
+        super().start()
+        self._leave_handle = asyncio.get_running_loop().call_later(
+            self.leave_after_s, self._depart
+        )
+
+    def _depart(self) -> None:
+        if self.left:
+            return
+        self.left = True
+        self._leave_handle = None
+        self.stop()
+        if self.on_depart is not None:
+            self.on_depart(self.id.id)
+
+    def stop(self) -> None:
+        if self._leave_handle is not None:
+            self._leave_handle.cancel()
+            self._leave_handle = None
+        super().stop()
+
+    def values(self) -> dict[str, float]:
+        return {**super().values(), "advLeftCt": float(self.left)}
+
+
 ADVERSARY_CLASSES = {
     ROLE_INVALID_SIGNER: InvalidSigner,
     ROLE_STALE_REPLAYER: StaleReplayer,
     ROLE_FLOODER: Flooder,
+    ROLE_CHURNER: Churner,
 }
 
 
@@ -211,6 +294,7 @@ def build_adversary(
     config=None,
     *,
     flood_pps: float = 200.0,
+    leave_after_s: float = 0.5,
 ):
     """Construct the adversarial node for `role` (Handel ctor signature,
     with the secret key in place of a pre-made own signature — the invalid
@@ -223,7 +307,11 @@ def build_adversary(
         if role == ROLE_INVALID_SIGNER
         else sk.sign(msg)
     )
-    kwargs = {"flood_pps": flood_pps} if role == ROLE_FLOODER else {}
+    kwargs = {}
+    if role == ROLE_FLOODER:
+        kwargs = {"flood_pps": flood_pps}
+    elif role == ROLE_CHURNER:
+        kwargs = {"leave_after_s": leave_after_s}
     return cls(
         network, registry, identity, constructor, msg, own_sig, config, **kwargs
     )

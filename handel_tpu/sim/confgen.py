@@ -16,9 +16,12 @@ from __future__ import annotations
 import os
 
 from handel_tpu.sim.config import (
+    AdversaryParams,
     HandelParams,
     RunConfig,
+    ScenarioParams,
     SimConfig,
+    SoakParams,
     SwarmParams,
     dump_config,
 )
@@ -209,6 +212,82 @@ def scenario_swarm(identities: int = 65536, processes: int = 1) -> SimConfig:
     )
 
 
+def _scenario_base(nodes: int, scenario: ScenarioParams,
+                   churner: int = 0, churn_after_ms: float = 300.0) -> SimConfig:
+    """Shared shape for the WAN scenario engine configs (`sim scenario`):
+    fake scheme (the WAN model, not pairings, is under test), tracing on
+    for region attribution, and a short [soak] section so the same TOML is
+    directly runnable as a `sim soak` workload too."""
+    return SimConfig(
+        scheme="fake",
+        trace=True,
+        trace_capacity=1 << 18,
+        max_timeout_s=60.0,
+        scenario=scenario,
+        soak=SoakParams(duration_s=20.0, nodes=min(nodes, 32)),
+        runs=[
+            RunConfig(
+                nodes=nodes,
+                processes=1,
+                adversaries=AdversaryParams(
+                    churner=churner, churn_after_ms=churn_after_ms
+                ),
+                handel=HandelParams(period_ms=10.0, timeout_ms=50.0),
+            )
+        ],
+    )
+
+
+def scenario_geo(nodes: int = 32) -> SimConfig:
+    """Geo-latency planet run: 3 regions, seeded per-link WAN delays,
+    region-tagged spans (`sim scenario --config geo.toml`)."""
+    return _scenario_base(
+        nodes,
+        ScenarioParams(
+            name="geo", planet="planet-3region-fast", jitter_ms=1.0,
+            geo_seed=7,
+        ),
+    )
+
+
+def scenario_churn(nodes: int = 32) -> SimConfig:
+    """Dynamic-membership run: ~10% of the committee departs mid-round on
+    a deterministic staggered schedule; survivors re-level and the
+    threshold stays reachable."""
+    return _scenario_base(
+        nodes,
+        ScenarioParams(name="churn", joins=2, geo_seed=7),
+        churner=max(1, nodes // 10),
+    )
+
+
+def scenario_weighted(nodes: int = 32) -> SimConfig:
+    """Stake-weighted run: heavy-tailed pareto weights, completion gated
+    on 60% of total stake instead of a contribution count."""
+    return _scenario_base(
+        nodes,
+        ScenarioParams(
+            name="weighted", weight_profile="pareto",
+            weight_threshold_frac=0.6, weight_seed=7,
+        ),
+    )
+
+
+def scenario_geo_weighted(nodes: int = 128) -> SimConfig:
+    """The capture shape: 5-region planet + >=10% churn + non-uniform
+    stake, all axes at once (results/geo_weighted_report.json)."""
+    return _scenario_base(
+        nodes,
+        ScenarioParams(
+            name="geo_weighted", planet="planet-5region", jitter_ms=3.0,
+            geo_seed=7, joins=4, weight_profile="pareto",
+            weight_threshold_frac=0.55, weight_seed=7,
+        ),
+        churner=max(1, nodes // 10),
+        churn_after_ms=400.0,
+    )
+
+
 SCENARIOS = {
     "node_count": scenario_node_count,
     "threshold_inc": scenario_threshold_inc,
@@ -221,6 +300,10 @@ SCENARIOS = {
     "gossipsub": scenario_gossipsub,
     "practical": scenario_practical,
     "swarm": scenario_swarm,
+    "geo": scenario_geo,
+    "churn": scenario_churn,
+    "weighted": scenario_weighted,
+    "geo_weighted": scenario_geo_weighted,
 }
 
 

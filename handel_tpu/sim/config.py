@@ -69,15 +69,26 @@ class AdversaryParams:
     stale_replayer: int = 0
     flooder: int = 0
     flood_pps: float = 200.0
+    # dynamic membership (scenario engine): nodes that participate honestly
+    # then DEPART mid-run, triggering survivor re-leveling + threshold
+    # re-evaluation (Handel.mark_departed)
+    churner: int = 0
+    churn_after_ms: float = 500.0
 
     def total(self) -> int:
-        return self.invalid_signer + self.stale_replayer + self.flooder
+        return (
+            self.invalid_signer
+            + self.stale_replayer
+            + self.flooder
+            + self.churner
+        )
 
     def counts(self) -> dict[str, int]:
         return {
             "invalid_signer": self.invalid_signer,
             "stale_replayer": self.stale_replayer,
             "flooder": self.flooder,
+            "churner": self.churner,
         }
 
 
@@ -206,6 +217,79 @@ class SwarmParams:
 
 
 @dataclass
+class ScenarioParams:
+    """`[scenario]` section: the WAN scenario engine (handel_tpu/scenario/).
+
+    One declarative knob set composing three orthogonal axes on top of any
+    run: a geo-latency planet model (GeoNetwork region RTT matrices), stake
+    weights (weighted thresholds in core/handel.py), and join-side dynamic
+    membership (epoch-staged registry admission). Departure-side churn
+    rides the existing adversary machinery (`[runs.adversaries] churner`).
+    All axes default off; a `[scenario]` with only `weight_profile =
+    "count"` activates the weighted code path with all-1.0 weights — by
+    construction bit-for-bit identical to the count threshold."""
+
+    name: str = ""  # label stamped into reports/captures
+    # -- geo planet model: a named preset (scenario/planets.py) OR an
+    # inline regions + rtt_ms matrix; preset wins when both are set ------
+    planet: str = ""
+    regions: list[str] = field(default_factory=list)
+    rtt_ms: list[list[float]] = field(default_factory=list)
+    jitter_ms: float = 0.0  # per-hop Gaussian jitter (std dev, ms)
+    geo_seed: int = 0
+    # -- dynamic membership: join-side admissions through the epoch path
+    # (lifecycle/epoch.py stage_registry/activate_staged) ----------------
+    joins: int = 0
+    join_at_frac: float = 0.5  # of the run window (scenario engine)
+    # -- stake weights: per-identity weight profile (scenario/weights.py);
+    # "" = count threshold (weighted path off) ---------------------------
+    weight_profile: str = ""  # "" | count | linear | pareto | split
+    weight_seed: int = 0
+    # weighted threshold as a fraction of total stake; 0 -> derive the
+    # same fraction the count threshold is of the node count
+    weight_threshold_frac: float = 0.0
+
+    def geo_enabled(self) -> bool:
+        return bool(self.planet or self.regions)
+
+    def weights_enabled(self) -> bool:
+        return bool(self.weight_profile)
+
+    def enabled(self) -> bool:
+        return self.geo_enabled() or self.weights_enabled() or self.joins > 0
+
+    def geo_config(self):
+        """Resolve preset/inline matrix into a validated GeoConfig
+        (region placement derives per node via .for_node)."""
+        from handel_tpu.network.geo import GeoConfig
+        from handel_tpu.scenario.planets import planet_preset
+
+        if self.planet:
+            regions, rtt = planet_preset(self.planet)
+        else:
+            regions, rtt = list(self.regions), [list(r) for r in self.rtt_ms]
+        return GeoConfig(
+            regions=regions,
+            rtt_ms=rtt,
+            jitter_ms=self.jitter_ms,
+            seed=self.geo_seed,
+        ).validate()
+
+    def make_weights(self, n: int):
+        from handel_tpu.scenario.weights import make_weights
+
+        return make_weights(self.weight_profile, n, seed=self.weight_seed)
+
+    def weight_threshold(self, count_threshold: int, n: int, weights) -> float:
+        total = float(sum(weights))
+        if self.weight_threshold_frac > 0.0:
+            return self.weight_threshold_frac * total
+        # same fraction of stake as the count threshold is of the node
+        # count — all-1.0 weights make this exactly `count_threshold`
+        return count_threshold * total / n
+
+
+@dataclass
 class HostSpec:
     """One host of the remote platform's fleet (sim/remote.py; the analog
     of an aws.go instance entry)."""
@@ -262,6 +346,8 @@ class SimConfig:
     soak: SoakParams = field(default_factory=SoakParams)
     # -- virtual-node swarm (handel_tpu/swarm/; `sim swarm`) ---------------
     swarm: SwarmParams = field(default_factory=SwarmParams)
+    # -- WAN scenario engine (handel_tpu/scenario/; `sim scenario`) --------
+    scenario: ScenarioParams = field(default_factory=ScenarioParams)
     # -- remote platform (sim/remote.py; aws.go analog) --------------------
     hosts: list[HostSpec] = field(default_factory=list)
     master_ip: str = "127.0.0.1"  # address remote nodes dial the master at
@@ -338,6 +424,20 @@ def load_config(path: str) -> SimConfig:
         autotune_every_s=float(so.get("autotune_every_s", 5.0)),
         trace_capacity=int(so.get("trace_capacity", 1 << 17)),
     )
+    sc = raw.get("scenario", {})
+    cfg.scenario = ScenarioParams(
+        name=str(sc.get("name", "")),
+        planet=str(sc.get("planet", "")),
+        regions=[str(x) for x in sc.get("regions", [])],
+        rtt_ms=[[float(v) for v in row] for row in sc.get("rtt_ms", [])],
+        jitter_ms=float(sc.get("jitter_ms", 0.0)),
+        geo_seed=int(sc.get("geo_seed", 0)),
+        joins=int(sc.get("joins", 0)),
+        join_at_frac=float(sc.get("join_at_frac", 0.5)),
+        weight_profile=str(sc.get("weight_profile", "")),
+        weight_seed=int(sc.get("weight_seed", 0)),
+        weight_threshold_frac=float(sc.get("weight_threshold_frac", 0.0)),
+    )
     sw = raw.get("swarm", {})
     cfg.swarm = SwarmParams(
         identities=int(sw.get("identities", 0)),
@@ -377,6 +477,8 @@ def load_config(path: str) -> SimConfig:
                     stale_replayer=int(a.get("stale_replayer", 0)),
                     flooder=int(a.get("flooder", 0)),
                     flood_pps=float(a.get("flood_pps", 200.0)),
+                    churner=int(a.get("churner", 0)),
+                    churn_after_ms=float(a.get("churn_after_ms", 500.0)),
                 ),
                 handel=HandelParams(
                     period_ms=float(h.get("period_ms", 10.0)),
@@ -467,6 +569,32 @@ def dump_config(cfg: SimConfig) -> str:
             f"autotune_every_s = {cfg.soak.autotune_every_s}",
             f"trace_capacity = {cfg.soak.trace_capacity}",
         ]
+    if cfg.scenario.enabled():
+        sc = cfg.scenario
+        lines += [
+            "",
+            "[scenario]",
+            f'name = "{sc.name}"',
+            f'planet = "{sc.planet}"',
+        ]
+        if sc.regions:
+            regions = ", ".join(f'"{r}"' for r in sc.regions)
+            lines.append(f"regions = [{regions}]")
+        if sc.rtt_ms:
+            rows = ", ".join(
+                "[" + ", ".join(str(v) for v in row) + "]"
+                for row in sc.rtt_ms
+            )
+            lines.append(f"rtt_ms = [{rows}]")
+        lines += [
+            f"jitter_ms = {sc.jitter_ms}",
+            f"geo_seed = {sc.geo_seed}",
+            f"joins = {sc.joins}",
+            f"join_at_frac = {sc.join_at_frac}",
+            f'weight_profile = "{sc.weight_profile}"',
+            f"weight_seed = {sc.weight_seed}",
+            f"weight_threshold_frac = {sc.weight_threshold_frac}",
+        ]
     if cfg.swarm.enabled():
         lines += [
             "",
@@ -510,6 +638,8 @@ def dump_config(cfg: SimConfig) -> str:
                 f"stale_replayer = {r.adversaries.stale_replayer}",
                 f"flooder = {r.adversaries.flooder}",
                 f"flood_pps = {r.adversaries.flood_pps}",
+                f"churner = {r.adversaries.churner}",
+                f"churn_after_ms = {r.adversaries.churn_after_ms}",
             ]
         lines += [
             "[runs.handel]",

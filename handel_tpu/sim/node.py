@@ -133,6 +133,18 @@ async def run_node_process(args) -> int:
     records = simkeys.read_registry_csv(args.registry)
     registry = simkeys.registry_from_records(records, scheme)
 
+    # WAN scenario plane (sim/config.py ScenarioParams): geo placement,
+    # stake weights, weighted threshold — all derived identically in every
+    # process from the shared TOML
+    scen = cfg.scenario
+    geo_base = scen.geo_config() if scen.geo_enabled() else None
+    weights = scen.make_weights(run.nodes) if scen.weights_enabled() else None
+    weight_threshold = (
+        scen.weight_threshold(threshold, run.nodes, weights)
+        if weights is not None
+        else 0.0
+    )
+
     # byzantine roles (sim/adversary.py): recompute the allocator's offline
     # set locally so every process derives the SAME id -> role mapping
     roles: dict[int, str] = {}
@@ -142,7 +154,14 @@ async def run_node_process(args) -> int:
         )
         offline = {nid for nid, slot in alloc.items() if not slot.active}
         roles = adversary_roles(run.adversaries.counts(), run.nodes, offline)
-        check_threshold_reachable(threshold, run.nodes, run.failing, roles)
+        check_threshold_reachable(
+            threshold,
+            run.nodes,
+            run.failing,
+            roles,
+            weights=weights,
+            weight_threshold=weight_threshold,
+        )
 
     # one transport per logical node, bound to its registry address
     nets, handels = [], []
@@ -221,7 +240,17 @@ async def run_node_process(args) -> int:
             net = QUICNetwork(rec.address, encoding=enc)
         else:
             net = UDPNetwork(rec.address, encoding=enc)
-        if cfg.chaos.any():
+        if geo_base is not None:
+            # geo-latency planet model (network/geo.py): region-pair WAN
+            # delay, chaos faults composed on top when any rate is set
+            from handel_tpu.network.geo import GeoNetwork
+
+            net = GeoNetwork(
+                net,
+                geo_base.for_node(nid),
+                chaos=cfg.chaos.for_node(nid) if cfg.chaos.any() else None,
+            )
+        elif cfg.chaos.any():
             # fault-injection plane (network/chaos.py): same transport
             # underneath, seeded per-link faults on top
             net = ChaosNetwork(net, cfg.chaos.for_node(nid))
@@ -260,6 +289,11 @@ async def run_node_process(args) -> int:
             hconf = run.handel.to_config(threshold, seed=nid)
             hconf.batch_size = cfg.batch_size
             hconf.recorder = recorder
+            if geo_base is not None:
+                hconf.region = geo_base.region_of(nid)
+            if weights is not None:
+                hconf.weights = weights
+                hconf.weight_threshold = weight_threshold
             if shared_service is not None:
                 hconf.verifier = shared_service.verify
             elif rpc_client is not None:
@@ -275,6 +309,7 @@ async def run_node_process(args) -> int:
                     sk,
                     hconf,
                     flood_pps=run.adversaries.flood_pps,
+                    leave_after_s=run.adversaries.churn_after_ms / 1000.0,
                 )
             else:
                 h = Handel(
@@ -287,6 +322,26 @@ async def run_node_process(args) -> int:
                     hconf,
                 )
         handels.append((nid, h, net))
+
+    # churn: a departing node notifies its co-located survivors directly
+    # (Handel.mark_departed -> re-level + threshold re-evaluation). Cross-
+    # process survivors see the departure as silence, exactly like a
+    # `failing` node — the callback is a process-local accelerant, not a
+    # consensus channel.
+    from handel_tpu.sim.adversary import ROLE_CHURNER
+
+    churners = [h for _, h, _ in handels if getattr(h, "role", None) == ROLE_CHURNER]
+    if churners:
+        survivors = [h for _, h, _ in handels]
+
+        def _on_depart(departed_id: int, _peers=survivors) -> None:
+            for p in _peers:
+                md = getattr(p, "mark_departed", None)
+                if md is not None:
+                    md(departed_id)
+
+        for ch in churners:
+            ch.on_depart = _on_depart
 
     # registry-backed scrape surfaces: every logical node's protocol (sigs),
     # transport (net) and peer-penalty planes under a node label, the
@@ -301,6 +356,8 @@ async def run_node_process(args) -> int:
                 mreg.register_histograms("sigs", h, labels=lbl)
             if hasattr(net, "values"):
                 mreg.register_values("net", net, labels=lbl)
+            if hasattr(net, "histograms"):
+                mreg.register_histograms("net", net, labels=lbl)
             scorer = getattr(h, "scorer", None)
             if scorer is not None:
                 mreg.register_values("penalty", scorer, labels=lbl)
@@ -349,6 +406,9 @@ async def run_node_process(args) -> int:
                   CounterIO(sink, "sigs", h)]
             if hasattr(h, "histograms"):
                 ms.append(HistogramIO(sink, "sigs", h))
+            if hasattr(net, "histograms"):
+                # chaos/geo delay distribution -> net_delayMs_p50/_p90/_p99
+                ms.append(HistogramIO(sink, "net", net))
             measures.append(tuple(ms))
         else:
             measures.append(None)

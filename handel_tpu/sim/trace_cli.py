@@ -343,6 +343,24 @@ def _chain_to_report(chain: list[dict], anchor: dict, device_ivs_of) -> dict:
             stages_us["device"] = stages_us.get("device", 0.0) + on_dev
             dur -= on_dev
         stages_us[stage] = stages_us.get(stage, 0.0) + dur
+    # region-pair attribution (scenario engine, network/geo.py): every
+    # cross-node hop pairs the sender's span region tag with the first
+    # downstream span recorded by a DIFFERENT node — "eu-west->ap-east"
+    # strings naming where the critical path's WAN time went
+    region_hops: list[str] = []
+    for i, e in enumerate(chain):
+        if e["name"] != "send":
+            continue
+        src = e.get("args", {}).get("region")
+        here = (e.get("pid", 0), e.get("tid", 0))
+        dst = None
+        for nxt in chain[i + 1:]:
+            r = nxt.get("args", {}).get("region")
+            if r and (nxt.get("pid", 0), nxt.get("tid", 0)) != here:
+                dst = r
+                break
+        if src and dst:
+            region_hops.append(f"{src}->{dst}")
     return {
         "anchor": {
             "pid": anchor.get("pid", 0),
@@ -355,6 +373,7 @@ def _chain_to_report(chain: list[dict], anchor: dict, device_ivs_of) -> dict:
         "coverage": _interval_union(ivs) / wall if wall > 0 else 1.0,
         "hops": sum(1 for e in chain if e["name"] == "send"),
         "stages_ms": {k: v / 1e3 for k, v in sorted(stages_us.items())},
+        "region_hops": region_hops,
         "chain": [
             {
                 "name": e["name"],
@@ -365,6 +384,7 @@ def _chain_to_report(chain: list[dict], anchor: dict, device_ivs_of) -> dict:
                 "origin": e.get("args", {}).get("origin"),
                 "level": e.get("args", {}).get("level"),
                 "span": e.get("args", {}).get("span"),
+                "region": e.get("args", {}).get("region"),
             }
             for e in chain
         ],
@@ -703,6 +723,8 @@ def print_critical_path(cp: dict | None) -> None:
     print("  stage breakdown: " + "  ".join(
         f"{k}={v:.2f}ms" for k, v in cp["stages_ms"].items()
     ))
+    if cp.get("region_hops"):
+        print("  region hops: " + "  ".join(cp["region_hops"]))
     for e in cp["chain"]:
         where = f"pid {e['pid']} tid {e['tid']}"
         tag = (

@@ -73,6 +73,10 @@ SIDE_METRICS = {
     # (swap + forced lane loss), and the SLO admission shed fraction
     "epoch_swap_stall_ms": "lower",
     "soak_p99_s": "lower",
+    # WAN scenario engine (sim scenario / scripts/scenario_smoke.py):
+    # wall to the weighted threshold under the composed geo + churn +
+    # stake-weight drill
+    "geo_weighted_ttt_s": "lower",
     "shed_rate": "lower",
 }
 

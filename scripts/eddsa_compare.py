@@ -1,0 +1,97 @@
+"""Ed25519 vs BN254-BLS comparison at one committee size.
+
+The scenario engine's weighted/geo runs are scheme-agnostic, which begs
+the question the results/README.md row answers: what does the aggregating
+curve actually buy? This script times the full signer-side + verifier-side
+pipeline for both host backends at the same committee size (default 64,
+Ed25519's MAX_SIGNERS envelope):
+
+  keygen     n deterministic keypairs
+  sign       n individual signatures over one message
+  aggregate  fold of Signature.combine (BLS: point adds; Ed25519: set union)
+  verify     aggregate-public-key verify of the combined signature
+  wire       marshal size of the combined signature
+
+Persists results/eddsa_compare.json (always — both backends are
+deterministic host code, no device provenance caveat applies).
+
+    python scripts/eddsa_compare.py [nodes]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from handel_tpu.models.registry import new_scheme
+
+MSG = b"eddsa-compare:handel scenario message"
+
+
+def _bench_scheme(name: str, n: int) -> dict:
+    scheme = new_scheme(name)
+    t0 = time.perf_counter()
+    pairs = [scheme.keygen(i) for i in range(n)]
+    t_keygen = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    sigs = [sk.sign(MSG) for sk, _ in pairs]
+    t_sign = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    agg_sig = sigs[0]
+    for s in sigs[1:]:
+        agg_sig = agg_sig.combine(s)
+    t_aggregate = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    agg_pub = pairs[0][1]
+    for _, pk in pairs[1:]:
+        agg_pub = agg_pub.combine(pk)
+    ok = agg_pub.verify(MSG, agg_sig)
+    t_verify = time.perf_counter() - t0
+    assert ok, f"{name}: aggregate verify failed"
+    assert not agg_pub.verify(b"tampered", agg_sig), f"{name}: forgery accepted"
+
+    wire = agg_sig.marshal()
+    assert len(wire) == scheme.constructor.signature_size()
+    return {
+        "keygen_ms": round(t_keygen * 1e3, 2),
+        "sign_ms": round(t_sign * 1e3, 2),
+        "aggregate_ms": round(t_aggregate * 1e3, 2),
+        "verify_ms": round(t_verify * 1e3, 2),
+        "agg_sig_bytes": len(wire),
+    }
+
+
+def main() -> int:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+    out = {
+        "metric": f"eddsa_vs_bn254_{n}n",
+        "nodes": n,
+        "message_bytes": len(MSG),
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "schemes": {
+            "eddsa": _bench_scheme("eddsa", n),
+            "bn254": _bench_scheme("bn254", n),
+        },
+    }
+    print(json.dumps(out, indent=1))
+    path = os.path.normpath(
+        os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "..", "results",
+            "eddsa_compare.json",
+        )
+    )
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+        f.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

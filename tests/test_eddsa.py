@@ -1,0 +1,144 @@
+"""Ed25519 baseline scheme: RFC 8032 vectors, set-union aggregation, wire.
+
+The non-aggregating control group for the BLS schemes (models/eddsa.py):
+correctness against the RFC test vectors, the kid-tagged signature-set
+combine semantics, the fixed-envelope wire round-trip through the
+Constructor contract, and registry dispatch.
+"""
+
+import asyncio
+
+import pytest
+
+from handel_tpu.core.bitset import BitSet
+from handel_tpu.core.crypto import MultiSignature, verify_multisignature
+from handel_tpu.core.identity import ArrayRegistry, Identity
+from handel_tpu.models.eddsa import (
+    MAX_SIGNERS,
+    EdDSAScheme,
+    EdDSASecretKey,
+    new_keypair,
+)
+from handel_tpu.models.registry import is_device_scheme, new_scheme
+
+MSG = b"eddsa unit message"
+
+
+def test_rfc8032_vectors():
+    # RFC 8032 §7.1 TEST 1 (empty message) and TEST 3 (two bytes)
+    sk1 = EdDSASecretKey(bytes.fromhex(
+        "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60"))
+    assert sk1.enc_pub == bytes.fromhex(
+        "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a")
+    assert next(iter(sk1.sign(b"").sigs.values())) == bytes.fromhex(
+        "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e0652249015"
+        "55fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b")
+    sk3 = EdDSASecretKey(bytes.fromhex(
+        "c5aa8df43f9f837bedb7442f31dcb7b166d38535076f094b85ce3a2e0b4458f7"))
+    assert next(iter(sk3.sign(b"\xaf\x82").sigs.values())) == bytes.fromhex(
+        "6291d657deec24024827e69c3abe01a30ce548a284743a445e3680d7db5ac3a"
+        "c18ff9b538d16f290ae67f760984dc6594a7c15e9716ed28dc027beceea1ec40a")
+
+
+def test_sign_verify_and_reject():
+    sk, pk = new_keypair(seed=1)
+    sig = sk.sign(MSG)
+    assert pk.verify(MSG, sig)
+    assert not pk.verify(b"other", sig)
+    _, pk2 = new_keypair(seed=2)
+    assert not pk2.verify(MSG, sig)  # wrong key: no matching kid entry
+
+
+def test_combine_is_union_and_order_independent():
+    pairs = [new_keypair(seed=i) for i in range(5)]
+    sigs = [sk.sign(MSG) for sk, _ in pairs]
+    fwd = sigs[0]
+    for s in sigs[1:]:
+        fwd = fwd.combine(s)
+    rev = sigs[-1]
+    for s in reversed(sigs[:-1]):
+        rev = rev.combine(s)
+    assert fwd.sigs == rev.sigs
+    agg_pk = pairs[0][1]
+    for _, pk in pairs[1:]:
+        agg_pk = agg_pk.combine(pk)
+    assert agg_pk.verify(MSG, fwd)
+    # one missing entry fails the aggregate check
+    partial = sigs[0]
+    for s in sigs[1:-1]:
+        partial = partial.combine(s)
+    assert not agg_pk.verify(MSG, partial)
+
+
+def test_wire_round_trip_fixed_envelope():
+    scheme = EdDSAScheme()
+    pairs = [scheme.keygen(i) for i in range(9)]
+    agg = pairs[0][0].sign(MSG)
+    for sk, _ in pairs[1:]:
+        agg = agg.combine(sk.sign(MSG))
+    wire = agg.marshal()
+    assert len(wire) == scheme.constructor.signature_size()
+    back = scheme.constructor.unmarshal_signature(wire)
+    assert back.sigs == agg.sigs
+    with pytest.raises(ValueError):
+        scheme.constructor.unmarshal_signature(wire[:100])
+
+
+def test_capacity_enforced():
+    pairs = [new_keypair(seed=i) for i in range(MAX_SIGNERS + 1)]
+    agg = pairs[0][0].sign(MSG)
+    for sk, _ in pairs[1:]:
+        agg = agg.combine(sk.sign(MSG))
+    with pytest.raises(ValueError):
+        agg.marshal()
+
+
+def test_public_key_round_trip():
+    scheme = EdDSAScheme()
+    sk, pk = scheme.keygen(4)
+    enc = pk.marshal()
+    assert len(enc) == 32
+    assert scheme.unmarshal_public(enc).verify(MSG, sk.sign(MSG))
+    assert scheme.unmarshal_secret(sk.marshal()).enc_pub == sk.enc_pub
+
+
+def test_registry_dispatch_and_multisignature():
+    scheme = new_scheme("ed25519")
+    assert not is_device_scheme("eddsa")
+    n = 6
+    pairs = [scheme.keygen(i) for i in range(n)]
+    reg = ArrayRegistry(
+        [Identity(i, f"eddsa-{i}", pk) for i, (_, pk) in enumerate(pairs)]
+    )
+    bs = BitSet(n)
+    agg = None
+    for i in (0, 2, 5):
+        bs.set(i, True)
+        s = pairs[i][0].sign(MSG)
+        agg = s if agg is None else agg.combine(s)
+    ms = MultiSignature(bs, agg)
+    assert verify_multisignature(MSG, ms, reg, scheme.constructor)
+    wire = ms.marshal()
+    back = MultiSignature.unmarshal(wire, scheme.constructor)
+    assert verify_multisignature(MSG, back, reg, scheme.constructor)
+    # a bitset claiming a signer whose entry is absent must fail
+    bs.set(1, True)
+    assert not verify_multisignature(
+        MSG, MultiSignature(bs, agg), reg, scheme.constructor
+    )
+
+
+@pytest.mark.slow
+def test_protocol_round_over_eddsa():
+    from handel_tpu.core.test_harness import LocalCluster
+
+    async def go():
+        cluster = LocalCluster(8, threshold=8, scheme=new_scheme("eddsa"))
+        cluster.start()
+        try:
+            finals = await cluster.wait_complete_success(timeout=60)
+        finally:
+            cluster.stop()
+        assert next(iter(finals.values())).bitset.cardinality() == 8
+
+    asyncio.run(go())

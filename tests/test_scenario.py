@@ -1,0 +1,285 @@
+"""WAN scenario engine: weights plane, churn reachability, geo model.
+
+Units for ISSUE 13's composed scenario axes: the weighted-bitset hot path
+against a scalar oracle (count-weights must reproduce popcount exactly),
+the churn-aware threshold reachability check at the margin, the seeded
+GeoNetwork delay distribution, membership-schedule determinism, the
+confgen `[scenario]` TOML round-trip, and one small end-to-end run per
+axis through `run_scenario`.
+"""
+
+import asyncio
+import math
+import random
+
+import pytest
+
+from handel_tpu.core.bitset import AllOnesBitSet, BitSet
+from handel_tpu.core.identity import Identity
+from handel_tpu.models.fake import FakePublic
+from handel_tpu.network.geo import GeoConfig, GeoNetwork
+from handel_tpu.scenario import (
+    MembershipSchedule,
+    make_weights,
+    planet_names,
+    planet_preset,
+    run_scenario,
+)
+from handel_tpu.sim.adversary import adversary_roles, check_threshold_reachable
+from handel_tpu.sim.config import dump_config, load_config
+from handel_tpu.sim.confgen import (
+    scenario_churn,
+    scenario_geo,
+    scenario_geo_weighted,
+    scenario_weighted,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# -- weighted bitset vs scalar oracle ---------------------------------------
+
+
+def test_weight_sum_matches_scalar_oracle():
+    rng = random.Random(13)
+    for n in (1, 7, 64, 200):
+        weights = [rng.uniform(0.1, 5.0) for _ in range(n)]
+        for _ in range(20):
+            bs = BitSet(n)
+            for i in range(n):
+                if rng.random() < 0.4:
+                    bs.set(i, True)
+            oracle = sum(weights[i] for i in bs.indices())
+            assert math.isclose(bs.weight_sum(weights), oracle, rel_tol=1e-12)
+
+
+def test_count_weights_reproduce_popcount_exactly():
+    # the strict no-op contract: all-1.0 weights == cardinality, bit-exact
+    rng = random.Random(7)
+    for n in (1, 33, 512):
+        ones = [1.0] * n
+        bs = BitSet(n)
+        for i in range(n):
+            if rng.random() < 0.5:
+                bs.set(i, True)
+        assert bs.weight_sum(ones) == float(bs.cardinality())
+        assert AllOnesBitSet(n).weight_sum(ones) == float(n)
+
+
+def test_weight_sum_empty_and_full():
+    weights = [2.0, 3.0, 5.0, 7.0]
+    assert BitSet(4).weight_sum(weights) == 0.0
+    full = BitSet(4)
+    for i in range(4):
+        full.set(i, True)
+    assert full.weight_sum(weights) == pytest.approx(17.0)
+    assert AllOnesBitSet(4).weight_sum(weights) == pytest.approx(17.0)
+
+
+# -- weight profiles ---------------------------------------------------------
+
+
+def test_weight_profiles_deterministic_and_normalized():
+    n = 64
+    assert make_weights("count", n) == [1.0] * n
+    for profile in ("linear", "pareto", "split"):
+        a = make_weights(profile, n, seed=3)
+        b = make_weights(profile, n, seed=3)
+        assert a == b
+        assert sum(a) == pytest.approx(float(n))  # normalized to sum == n
+    assert make_weights("pareto", n, seed=3) != make_weights("pareto", n, seed=4)
+    with pytest.raises(ValueError):
+        make_weights("nope", n)
+
+
+# -- churn-aware threshold reachability --------------------------------------
+
+
+def test_churn_reachability_count_margin():
+    # 16 nodes, 2 churners, 1 failing -> 13 guaranteed honest contributions
+    roles = adversary_roles({"churner": 2}, 16)
+    check_threshold_reachable(12, 16, 1, roles)  # below margin
+    check_threshold_reachable(13, 16, 1, roles)  # at margin
+    with pytest.raises(ValueError):
+        check_threshold_reachable(14, 16, 1, roles)  # above margin
+
+
+def test_departed_identities_reduce_reachability():
+    check_threshold_reachable(14, 16, 0, {}, departed={1, 2})
+    with pytest.raises(ValueError):
+        check_threshold_reachable(15, 16, 0, {}, departed={1, 2})
+    # departed churners are not double-counted
+    roles = adversary_roles({"churner": 2}, 16)
+    departed = set(roles)
+    check_threshold_reachable(14, 16, 0, roles, departed=departed)
+
+
+def test_weighted_reachability_counts_heaviest_failing():
+    # ids 0..3 weights 1,2,3,10; churner on id 3 removes the whale; the one
+    # failing node then worst-cases onto the heaviest survivor (3.0)
+    weights = [1.0, 2.0, 3.0, 10.0]
+    roles = {3: "churner"}
+    check_threshold_reachable(0, 4, 1, roles, weights=weights,
+                              weight_threshold=3.0)
+    with pytest.raises(ValueError):
+        check_threshold_reachable(0, 4, 1, roles, weights=weights,
+                                  weight_threshold=3.1)
+    # derived threshold path: want = threshold * sum(w) / n, 6.0 reachable
+    check_threshold_reachable(1, 4, 0, roles, weights=weights)  # want 4.0
+    with pytest.raises(ValueError):
+        check_threshold_reachable(2, 4, 0, roles, weights=weights)  # want 8.0
+
+
+# -- geo model ----------------------------------------------------------------
+
+
+class _CountingInner:
+    def __init__(self):
+        self.sent = []
+
+    def send(self, idents, packet):
+        self.sent.append((list(idents), packet))
+
+
+def _geo(seed=7, jitter=0.0):
+    return GeoConfig(
+        regions=("a", "b"),
+        rtt_ms=((0.0, 100.0), (100.0, 0.0)),
+        jitter_ms=jitter,
+        seed=seed,
+        node_id=0,  # region "a"
+    )
+
+
+def test_geo_rtt_distribution_sanity():
+    net = GeoNetwork(_CountingInner(), _geo(jitter=5.0))
+    far = Identity(1, "fake-1", FakePublic(True))  # region "b"
+    near = Identity(2, "fake-2", FakePublic(True))  # region "a"
+    samples = [net.sample_delay_ms(far) for _ in range(600)]
+    mean = sum(samples) / len(samples)
+    assert abs(mean - 50.0) < 2.0  # one-way = RTT/2, jitter is zero-mean
+    assert min(samples) >= 0.0
+    sd = math.sqrt(sum((s - mean) ** 2 for s in samples) / len(samples))
+    assert 3.5 < sd < 6.5
+    # same-region link: pure jitter around 0, clamped non-negative
+    assert all(0.0 <= net.sample_delay_ms(near) < 30.0 for _ in range(50))
+
+
+def test_geo_sampling_is_seed_deterministic():
+    far = Identity(1, "fake-1", FakePublic(True))
+    a = [GeoNetwork(_CountingInner(), _geo(seed=7, jitter=3.0))
+         .sample_delay_ms(far) for _ in range(1)]
+    a_again = [GeoNetwork(_CountingInner(), _geo(seed=7, jitter=3.0))
+               .sample_delay_ms(far) for _ in range(1)]
+    b = [GeoNetwork(_CountingInner(), _geo(seed=8, jitter=3.0))
+         .sample_delay_ms(far) for _ in range(1)]
+    assert a == a_again
+    assert a != b
+
+
+def test_geo_records_delay_histogram_and_counter():
+    inner = _CountingInner()
+    net = GeoNetwork(inner, _geo())
+    far = Identity(1, "fake-1", FakePublic(True))
+    pkt = object()
+    net._deliver(far, pkt)  # no running loop: sync fallback still records
+    assert inner.sent, "sync fallback must deliver immediately"
+    assert net.geo_delayed == 1
+    hists = net.histograms()
+    assert "delayMs" in hists
+    assert hists["delayMs"].count == 1
+    assert net.values()["geoDelayed"] == 1.0
+
+
+def test_planet_presets_validate():
+    for name in planet_names():
+        regions, rtt = planet_preset(name)
+        GeoConfig(regions=regions, rtt_ms=rtt).validate()
+        # symmetric, with intra-region RTT strictly the row minimum
+        n = len(regions)
+        for i in range(n):
+            assert rtt[i][i] == min(rtt[i])
+            for j in range(n):
+                assert rtt[i][j] == rtt[j][i]
+                if i != j:
+                    assert rtt[i][j] > rtt[i][i]
+    with pytest.raises(ValueError):
+        planet_preset("planet-unknown")
+
+
+# -- membership schedule ------------------------------------------------------
+
+
+def test_membership_schedule_deterministic_and_staggered():
+    a = MembershipSchedule(32, churner_ids=[29, 30, 31], churn_after_s=0.4,
+                           joins=2, join_at_s=1.0, seed=5)
+    b = MembershipSchedule(32, churner_ids=[31, 30, 29], churn_after_s=0.4,
+                           joins=2, join_at_s=1.0, seed=5)
+    assert a.events == b.events  # id order at the call site is irrelevant
+    leaves = a.leaves()
+    assert {e.node_id for e in leaves} == {29, 30, 31}
+    for e in leaves:
+        assert 0.4 * 0.75 <= e.at_s <= 0.4 * 1.25
+    assert len(set(e.at_s for e in leaves)) == 3  # actually staggered
+    assert [e.node_id for e in a.joins()] == [32, 33]
+    assert a.final_size() == 32 - 3 + 2
+    assert a.leave_time_of(30) is not None
+    assert a.leave_time_of(0) is None
+
+
+# -- confgen round-trip -------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "factory", [scenario_geo, scenario_churn, scenario_weighted,
+                scenario_geo_weighted],
+)
+def test_scenario_toml_round_trip(factory, tmp_path):
+    cfg = factory()
+    text = dump_config(cfg)
+    path = tmp_path / "scenario.toml"
+    path.write_text(text)
+    reloaded = load_config(str(path))
+    assert dump_config(reloaded) == text  # stable fixed point
+    s0, s1 = cfg.scenario, reloaded.scenario
+    assert s1.enabled()
+    assert (s1.name, s1.planet, s1.weight_profile, s1.joins) == (
+        s0.name, s0.planet, s0.weight_profile, s0.joins
+    )
+    assert s1.weight_threshold_frac == s0.weight_threshold_frac
+    a0, a1 = cfg.runs[0].adversaries, reloaded.runs[0].adversaries
+    assert a1.churner == a0.churner
+    if a0.churner:  # churn_after_ms only rides the wire with a churner
+        assert a1.churn_after_ms == a0.churn_after_ms
+
+
+# -- end-to-end scenario runs (small, fake scheme) ---------------------------
+
+
+def _shrink(cfg, nodes):
+    cfg.runs[0].nodes = nodes
+    cfg.runs[0].threshold = 0  # re-derive the default for the new size
+    return cfg
+
+
+def test_run_scenario_geo_end_to_end(tmp_path):
+    cfg = _shrink(scenario_geo(), 8)
+    report = run(run_scenario(cfg, str(tmp_path)))
+    assert report["ok"], report["checks"]
+    assert report["scenario"]["regions"]
+    assert report["checks"]["region_attributed"]
+    assert (tmp_path / "scenario_report.json").exists()
+    assert (tmp_path / "scenario_trace.json").exists()
+
+
+@pytest.mark.slow
+def test_run_scenario_churn_weighted_end_to_end(tmp_path):
+    cfg = scenario_geo_weighted(32)
+    report = run(run_scenario(cfg, str(tmp_path)))
+    assert report["ok"], report["checks"]
+    s = report["scenario"]
+    assert s["churners"] >= 3 and s["departed_ids"]
+    assert s["epochs_advanced"] >= 1
+    assert s["achieved_weight"] >= s["weight_threshold"] - 1e-9
