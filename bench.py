@@ -67,8 +67,16 @@ def _probe_default_backend(timeout_s: float = 90.0) -> bool:
 
 
 def _probe_cache_path() -> str:
-    """Host-local probe-verdict file (NOT a committed artifact): keyed into
-    the system tempdir so every checkout/run on one host shares it."""
+    """Host-local probe-verdict file (NOT a committed artifact), shared by
+    every checkout/run on one host.
+
+    Lives under a STABLE per-user cache root (XDG_CACHE_HOME, else
+    ~/.cache) — NOT tempfile.gettempdir(): the tempdir honors TMPDIR,
+    which bench drivers commonly point at a fresh per-round directory, so
+    a verdict written there evaporates between rounds and the full
+    unreachable-retry ladder replays every time (BENCH_r05's ~8.5 min
+    tail, despite the verdict having been recorded). The tempdir remains
+    only the last-resort fallback when no home directory resolves."""
     import getpass
     import tempfile
 
@@ -79,7 +87,16 @@ def _probe_cache_path() -> str:
         user = getpass.getuser()
     except (KeyError, OSError):
         user = str(os.getuid()) if hasattr(os, "getuid") else "any"
-    return os.path.join(tempfile.gettempdir(), f"handel_tpu_probe_{user}.json")
+    root = os.environ.get("XDG_CACHE_HOME", "").strip()
+    if not root:
+        home = os.path.expanduser("~")
+        if home and home != "~":
+            root = os.path.join(home, ".cache")
+    if not root:
+        return os.path.join(
+            tempfile.gettempdir(), f"handel_tpu_probe_{user}.json"
+        )
+    return os.path.join(root, "handel_tpu", f"probe_{user}.json")
 
 
 def _cached_probe_failure() -> float | None:
@@ -99,12 +116,15 @@ def _cached_probe_failure() -> float | None:
 
 def _record_probe_verdict(reachable: bool) -> None:
     try:
+        path = _probe_cache_path()
+        parent = os.path.dirname(path)
+        if parent:  # the ~/.cache/handel_tpu dir may not exist yet
+            os.makedirs(parent, exist_ok=True)
         write_json_atomic(
-            _probe_cache_path(),
-            {"reachable": reachable, "checked_at": time.time()},
+            path, {"reachable": reachable, "checked_at": time.time()}
         )
     except OSError:
-        pass  # a read-only tempdir must not fail the bench
+        pass  # a read-only cache root must not fail the bench
 
 
 def _probe_with_retries() -> bool:
@@ -722,7 +742,11 @@ def build_problem(
 def _fp_microbench() -> None:
     """Capture the ops/fp.py throughput figure as a persisted artifact
     (round-2 verdict, "What's weak" #5: the ~150M mults/s docstring claim
-    had no in-repo capture)."""
+    had no in-repo capture). Measures BOTH Field backends (CIOS and RNS)
+    under the same chained-dispatch methodology: the legacy headline keys
+    stay CIOS (history continuity), and a per-fp_backend "records" list
+    carries one `mont_muls_per_s` row each for scripts/bench_check.py's
+    like-for-like gate (a CIOS row never judges an RNS row)."""
     import contextlib
 
     import jax
@@ -730,11 +754,18 @@ def _fp_microbench() -> None:
     from handel_tpu.ops.fp import _throughput_bench
 
     batch = int(os.environ.get("HANDEL_TPU_BENCH_FP_BATCH", str(1 << 18)))
+    measured = {}
     with contextlib.redirect_stdout(sys.stderr):
         # the microbench prints a human line; stdout is reserved for the
         # single headline JSON line
-        rate, floor = _throughput_bench(batch=batch, trials=3)
-    if rate <= 0 and os.path.exists(FP_ARTIFACT):
+        for fp_backend in ("cios", "rns"):
+            measured[fp_backend] = _throughput_bench(
+                batch=batch, trials=3, backend=fp_backend
+            )
+    rate, floor = measured["cios"]
+    if all(r <= 0 for r, _ in measured.values()) and os.path.exists(
+        FP_ARTIFACT
+    ):
         # a failed slope measurement must not erase previously captured
         # valid evidence (same resilience contract as the main artifact)
         print(
@@ -756,6 +787,24 @@ def _fp_microbench() -> None:
             extra = {k: prev[k] for k in ("mxu_lab", "note") if k in prev}
         except (json.JSONDecodeError, OSError):
             pass
+    now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    records = [
+        {
+            "metric": "mont_muls_per_s",
+            "value": round(r / 1e6, 1),
+            "invalid_measurement": r <= 0,
+            "unit": "M muls/s",
+            "dispatch_floor_ms": round(f * 1e3, 1),
+            "backend": jax.default_backend(),
+            "fp_backend": fp_backend,
+            "batch": batch,
+            "captured_at": now,
+            # the reconciliation note travels with every new record so a
+            # reader of one row still sees the one-number story
+            **({"note": extra["note"]} if "note" in extra else {}),
+        }
+        for fp_backend, (r, f) in measured.items()
+    ]
     write_json_atomic(
         FP_ARTIFACT,
         {
@@ -768,9 +817,10 @@ def _fp_microbench() -> None:
             "unit": "M muls/s",
             "dispatch_floor_ms": round(floor * 1e3, 1),
             "backend": jax.default_backend(),
-            "device": str(jax.devices()[0]),
             "batch": batch,
-            "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "captured_at": now,
+            "device": str(jax.devices()[0]),
+            "records": records,
             **extra,
         },
     )

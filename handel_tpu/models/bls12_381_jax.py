@@ -47,6 +47,7 @@ class BLS12381JaxConstructor(BLS12381Constructor, BN254JaxConstructor):
         curves: BLS12Curves | None = None,
         mesh_devices: int = 1,
         warmup: bool = True,
+        fp_backend: str | None = None,
     ):
         BN254JaxConstructor.__init__(
             self,
@@ -54,6 +55,7 @@ class BLS12381JaxConstructor(BLS12381Constructor, BN254JaxConstructor):
             curves=curves,
             mesh_devices=mesh_devices,
             warmup=warmup,
+            fp_backend=fp_backend,
         )
 
 
@@ -66,7 +68,11 @@ class BLS12381JaxScheme(BLS12381Scheme):
         batch_size: int = 16,
         mesh_devices: int = 1,
         warmup: bool = True,
+        fp_backend: str | None = None,
     ):
         self.constructor = BLS12381JaxConstructor(
-            batch_size=batch_size, mesh_devices=mesh_devices, warmup=warmup
+            batch_size=batch_size,
+            mesh_devices=mesh_devices,
+            warmup=warmup,
+            fp_backend=fp_backend,
         )

@@ -1187,10 +1187,14 @@ class BN254JaxConstructor(BN254Constructor):
         warmup: bool = True,
         host_fallback: bool = True,
         breaker: CircuitBreaker | None = None,
+        fp_backend: str | None = None,
     ):
         self.batch_size = batch_size
         self.mesh_devices = mesh_devices
-        self.curves = curves or self.Device.Curves()
+        self.fp_backend = fp_backend
+        # fp_backend picks the Field modmul kernel (ops/fp.py backend seam:
+        # "cios"/"rns"); an explicit `curves` wins, carrying its own Field
+        self.curves = curves or self.Device.Curves(backend=fp_backend)
         self.warmup = warmup
         self.host_fallback = host_fallback
         self.breaker = breaker or CircuitBreaker()
@@ -1281,9 +1285,13 @@ class BN254JaxScheme(BN254Scheme):
         batch_size: int = 16,
         mesh_devices: int = 1,
         warmup: bool = True,
+        fp_backend: str | None = None,
     ):
         self.constructor = BN254JaxConstructor(
-            batch_size=batch_size, mesh_devices=mesh_devices, warmup=warmup
+            batch_size=batch_size,
+            mesh_devices=mesh_devices,
+            warmup=warmup,
+            fp_backend=fp_backend,
         )
 
 

@@ -344,8 +344,16 @@ class BN254Curves:
     params = bn
     g1_b3 = 9  # 3*b for E: y^2 = x^3 + 3
 
-    def __init__(self, field: Field | None = None, tower: Tower | None = None):
-        self.F = field or Field(self.params.P)
+    def __init__(
+        self,
+        field: Field | None = None,
+        tower: Tower | None = None,
+        backend: str | None = None,
+    ):
+        # `backend` picks the Field modmul kernel ("cios"/"rns", ops/fp.py
+        # seam); everything above the Field — tower, curve adapters, pairing
+        # — routes through whichever kernel the constructed Field carries.
+        self.F = field or Field(self.params.P, backend=backend)
         self.T = tower or Tower(self.F, params=self.params)
         self.g1 = Curve(_FpAdapter(self.F, b3=self.g1_b3))
         self.g2 = Curve(_Fp2Adapter(self.T, params=self.params))

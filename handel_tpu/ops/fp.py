@@ -39,7 +39,37 @@ Design:
     aren't available (CPU tests); both paths are cross-validated.
 
 All values are kept canonical (< p) at op boundaries. Elements are in
-Montgomery form (R = 2^(16*NLIMBS)) except where a method says otherwise.
+Montgomery form except where a method says otherwise; the Montgomery
+constant is backend-specific (R = 2^(16*NLIMBS) for CIOS, the base-A
+product M for RNS) but canonical non-Montgomery boundary values are
+bit-identical across backends.
+
+**Backend seam.** `Field(p, backend=...)` selects the modmul kernel:
+
+  * ``backend="cios"`` (default) — this module's CIOS kernel above.
+  * ``backend="rns"`` — `ops/rns.py`'s residue-number-system Montgomery
+    pipeline, which restructures the multiply into constant-matrix
+    `dot_general` contractions so the MXU (idle under CIOS — contraction
+    depth 1, ~47x headroom vs the measured 16.7 T int8-ops/s ceiling,
+    scripts/mxu_limb_lab.py) carries the bulk work. `Field.__new__`
+    redirects construction to `RnsField`, a subclass overriding only
+    `mul`; everything else here (add/sub/inv/pow/pack/unpack, the
+    carry-lookahead machinery) is inherited, and `ops/tower.py`'s
+    batch-stacking entry points route through whichever kernel the
+    constructed Field carries — `BN254Device` dispatch, the fleet plane,
+    and the lifecycle/epoch paths inherit the backend transparently.
+    Config plumbing: `fp_backend` in the TOML -> SimConfig ->
+    models/bn254_jax.py -> ops/curve.py -> here. The CIOS kernel stays
+    the bit-exact oracle (tests/test_fp_jax.py, scripts/rns_smoke.py).
+
+Figure walk-through (results/fp_microbench.json): the artifact's `note`
+reconciles the four CIOS figures (15.5M naive-timing error / 13.1M
+small-batch mxu_lab control / 357M production marginal / 250-436M tunnel
+weather band); per-backend `mont_muls_per_s` records measured under the
+SAME chained-dispatch methodology (`chained_marginal`, shared by
+`_throughput_bench`, scripts/fp_kernel_lab.py, and scripts/mxu_limb_lab.py)
+sit beside it and are gated like-for-like by scripts/bench_check.py —
+a CIOS row never judges an RNS row.
 
 Correctness oracle: ops/bn254_ref.py; property tests in tests/test_fp_jax.py.
 """
@@ -177,9 +207,28 @@ class Field:
     All jax methods take/return uint32 arrays of shape (nlimbs, B) in
     Montgomery form (except where noted) and are jit/shard-safe. B must be a
     multiple of 128 for the Pallas path; `pad_batch` helps callers comply.
+
+    `backend` selects the modmul kernel: "cios" (this class) or "rns"
+    (ops/rns.py — `__new__` redirects construction there). Canonical
+    non-Montgomery boundary values are bit-identical across backends.
     """
 
-    def __init__(self, p: int, use_pallas: bool | None = None):
+    backend = "cios"
+
+    def __new__(cls, p: int = 0, use_pallas: bool | None = None,
+                backend: str | None = None):
+        if cls is Field and backend == "rns":
+            from handel_tpu.ops.rns import RnsField  # lazy: avoid cycle
+
+            return super().__new__(RnsField)
+        return super().__new__(cls)
+
+    def __init__(self, p: int, use_pallas: bool | None = None,
+                 backend: str | None = None):
+        if backend not in (None, "cios", "rns"):
+            raise ValueError(
+                f"unknown Field backend {backend!r} (want 'cios' or 'rns')"
+            )
         self.p = p
         self.nlimbs = (p.bit_length() + LIMB_BITS - 1) // LIMB_BITS
         n = self.nlimbs
@@ -564,66 +613,86 @@ class Field:
         return self.mul(a, one)
 
 
-def _throughput_bench(batch: int = 1 << 18, trials: int = 4):
-    """Substantiates the module docstring's mult/s figure; run with
-    `python -m handel_tpu.ops.fp [batch]` on the target backend.
+def chained_marginal(fn, a, b, k1: int = 8, k2: int = 72, trials: int = 4):
+    """Marginal throughput of a binary op under chained dispatch — THE
+    methodology every throughput figure in results/fp_microbench.json uses
+    (shared by `_throughput_bench`, scripts/fp_kernel_lab.py, and
+    scripts/mxu_limb_lab.py so the candidates stay comparable).
 
-    Methodology: on this environment's tunneled TPU a single dispatch pays
-    a ~30-90 ms host<->device round trip that dwarfs the kernel, so a naive
-    time-one-call loop measures the tunnel, not the VPU (that error produced
-    the 15.5M/s figure first captured in results/fp_microbench.json).
-    Instead, time k1- and k2-deep chains of dependent muls inside ONE jitted
-    executable, force completion with a 16-word device_get, and report the
-    marginal rate (k2-k1)*batch/(t2-t1) — the dispatch/fetch overhead
-    cancels in the difference. Returns (marginal_rate, dispatch_floor_s)."""
+    On this environment's tunneled TPU a single dispatch pays a ~30-90 ms
+    host<->device round trip that dwarfs the kernel, so a naive
+    time-one-call loop measures the tunnel, not the chip (that error
+    produced the 15.5M/s figure first captured in fp_microbench.json).
+    Instead: time k1- and k2-deep chains of dependent `fn(out, b)` calls
+    inside ONE jitted executable each (best of `trials`, completion forced
+    by a one-column device_get), and report the slope
+    (k2-k1)*batch/(t2-t1) — dispatch/fetch overhead cancels in the
+    difference. Returns (rate_ops_per_s, dispatch_floor_s); rate is None
+    when the slope is non-positive after one retry (timing noise at tiny
+    batches): a non-measurement, never an absurd figure.
+    """
     import time
 
     import jax
-
-    from handel_tpu.ops import bn254_ref as bn
-
-    F = Field(bn.P)
-    rng = np.random.default_rng(1)
-    a = jnp.asarray(rng.integers(0, 1 << LIMB_BITS, (F.nlimbs, batch), np.uint32))
-    b = jnp.asarray(rng.integers(0, 1 << LIMB_BITS, (F.nlimbs, batch), np.uint32))
 
     def chain(k):
         def f(x, y):
             out = x
             for _ in range(k):
-                out = F.mul(out, y)
+                out = fn(out, y)
             return out
 
         return jax.jit(f)
 
-    def best_of(fn):
-        jax.device_get(fn(a, b)[:, :1])  # compile + warm
+    def best_of(cf):
+        jax.device_get(cf(a, b)[:, :1])  # compile + warm
         best = float("inf")
         for _ in range(trials):
             t0 = time.perf_counter()
-            jax.device_get(fn(a, b)[:, :1])
+            jax.device_get(cf(a, b)[:, :1])
             best = min(best, time.perf_counter() - t0)
         return best
 
-    k1, k2 = 8, 72
     c1, c2 = chain(k1), chain(k2)
     t1, t2 = best_of(c1), best_of(c2)
     if t2 <= t1:  # timing noise (tiny batches / tunnel hiccup): one retry
         t1, t2 = best_of(c1), best_of(c2)
     if t2 <= t1:
-        # a non-positive slope is NOT a throughput measurement; report it as
-        # invalid rather than persisting an absurd figure
-        print(
-            f"{jax.default_backend()}: marginal slope not measurable "
-            f"(t1={t1*1e3:.2f} ms >= t2={t2*1e3:.2f} ms at batch {batch}) — "
-            f"increase batch or chain depth",
-        )
-        return 0.0, t1
+        return None, t1
+    batch = a.shape[-1]
     rate = (k2 - k1) * batch / (t2 - t1)
     floor = max(t1 - k1 * batch / rate, 0.0)
+    return rate, floor
+
+
+def _throughput_bench(
+    batch: int = 1 << 18, trials: int = 4, backend: str = "cios"
+):
+    """Substantiates the module docstring's mult/s figure; run with
+    `python -m handel_tpu.ops.fp [batch] [backend]` on the target chip.
+    Chained-dispatch marginal methodology — see `chained_marginal`.
+    Returns (marginal_rate, dispatch_floor_s); rate 0.0 when the slope is
+    not measurable."""
+    import jax
+
+    from handel_tpu.ops import bn254_ref as bn
+
+    F = Field(bn.P, backend=backend)
+    rng = np.random.default_rng(1)
+    a = jnp.asarray(rng.integers(0, 1 << LIMB_BITS, (F.nlimbs, batch), np.uint32))
+    b = jnp.asarray(rng.integers(0, 1 << LIMB_BITS, (F.nlimbs, batch), np.uint32))
+    k1, k2 = 8, 72
+    rate, floor = chained_marginal(F.mul, a, b, k1=k1, k2=k2, trials=trials)
+    if rate is None:
+        print(
+            f"{jax.default_backend()}: marginal slope not measurable "
+            f"(floor ~{floor*1e3:.2f} ms at batch {batch}) — "
+            f"increase batch or chain depth",
+        )
+        return 0.0, floor
     print(
         f"{jax.default_backend()}: {rate/1e6:.1f}M {bn.P.bit_length()}-bit "
-        f"mont-muls/s marginal (batch {batch}, chain {k1}->{k2}, "
+        f"mont-muls/s marginal [{backend}] (batch {batch}, chain {k1}->{k2}, "
         f"dispatch floor ~{floor*1e3:.1f} ms)"
     )
     return rate, floor
@@ -632,4 +701,7 @@ def _throughput_bench(batch: int = 1 << 18, trials: int = 4):
 if __name__ == "__main__":
     import sys
 
-    _throughput_bench(int(sys.argv[1]) if len(sys.argv) > 1 else 1 << 20)
+    _throughput_bench(
+        int(sys.argv[1]) if len(sys.argv) > 1 else 1 << 20,
+        backend=sys.argv[2] if len(sys.argv) > 2 else "cios",
+    )

@@ -1,0 +1,367 @@
+"""Residue-number-system Montgomery multiplication — the MXU-shaped modmul.
+
+The production CIOS kernel (ops/fp.py) is a VPU workload: a 254-bit limb
+product is an outer product (contraction depth 1), so the 128x128 systolic
+array contributes nothing and the measured 16.7 T int8-ops/s MXU ceiling
+(results/fp_microbench.json "mxu_lab") sits idle through every pairing.
+RNS restructures the same arithmetic so the heavy steps ARE deep matmul
+contractions against constant matrices — the shape the AI-ASIC ZKP
+literature targets (PAPERS.md, arxiv 2604.17808; ROADMAP item 1):
+
+  * **Residue mapping.** An operand's 8-bit limb vector (2n, B) maps to
+    residues mod k small coprime primes via one constant-matrix contraction
+    ``W @ limbs`` with ``W[i, j] = 2^(8j) mod m_i`` — contraction depth 2n
+    (32 for BN254), batch B in the other MXU dimension.
+  * **Residue-wise product.** Elementwise (k, B) int32 lane multiplies —
+    k ≈ 42 small products replace the CIOS kernel's n^2 = 256 limb products
+    plus its interleaved reduction columns.
+  * **Montgomery step in RNS** (Bajard/Kawamura shape). With base A
+    (product M, the RNS Montgomery constant) and base B (product MB):
+    q = T·(-p^{-1}) mod M is elementwise in base A; extending q's residues
+    to base B is another constant-matrix contraction ``E @ xi`` with
+    ``E[j, i] = (M/m_i) mod m_j``; then r = (T + q·p)/M is elementwise in
+    base B. The extension is offset-tolerant (q may come out as q + c·M,
+    c < k_A): it only shifts r by c·p, absorbed by the final reduction.
+  * **Exact CRT reconstruction** (Shenoy–Kumaresan). A redundant channel
+    m_r rides the whole pipeline, so the CRT offset alpha in
+    r = sum(xi'_j · MB/m_j) - alpha·MB is recovered EXACTLY (alpha < k_B
+    <= m_r) — no floating-point base-extension approximation anywhere in
+    the value path. Positional limbs come back via a third constant
+    contraction against the 8-bit limb decomposition of the MB/m_j.
+
+`RnsField` keeps the public Field contract intact: canonical (< p)
+(nlimbs, B) uint32 limbs at every op boundary, so `ops/tower.py`'s
+batch-stacking entry points, the curve adapters, and `BN254Device`
+dispatch route through unchanged — CRT reconstruction is paid inside
+`mul`, i.e. exactly at the boundaries where tower/pairing consume
+positional form (line evaluations, Frobenius twists, final-exponentiation
+exits all call back into add/sub/eq which need positional limbs).
+add/sub/neg/inv/pow/select/eq are inherited verbatim.
+
+**Montgomery convention.** The backend's Montgomery constant is M (the
+base-A product), not the CIOS kernel's R = 2^(16n): division by M is what
+the RNS reduction gets for free. `mont_r`/`mont_r2` are overridden
+accordingly, so pack/unpack/to_mont/from_mont stay self-consistent and
+every *non-Montgomery* boundary value (unpacked results, verify verdicts,
+affine coordinates) is bit-identical to the CIOS backend — that is the
+bit-exactness contract tests/test_fp_jax.py and scripts/rns_smoke.py pin.
+
+**Exactness.** Every modular reduction is the float-assisted
+`v - floor(v/m)·m` with integer correction (`_mod_rows`): the float
+estimate may be off by ±1, the integer fix-up makes the result exact, so
+the whole pipeline is integer-exact end to end. All intermediate
+magnitudes are proven < 2^30 (comments at each site), inside int32.
+
+On CPU the contractions run as single int32 `dot_general`s (exact, XLA);
+`int8_dots=True` (default on accelerators) splits each constant matrix
+and operand into <=7-bit planes so every contraction is an int8 x int8 ->
+int32 MXU matmul — bit-identical output, property-tested against the
+int32 lowering.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from handel_tpu.ops.fp import (
+    LIMB_BITS,
+    LIMB_MASK,
+    Field,
+    _has_pallas_tpu,
+    _int_to_limbs,
+)
+
+_PRIME_BOUND = 1 << 13  # residue moduli < 2^13: products and dot terms fit int32
+
+
+def _small_primes_desc(bound: int) -> list[int]:
+    sieve = np.ones(bound, dtype=bool)
+    sieve[:2] = False
+    for i in range(2, int(bound**0.5) + 1):
+        if sieve[i]:
+            sieve[i * i :: i] = False
+    return [int(x) for x in np.nonzero(sieve)[0][::-1]]
+
+
+def _limbs8(x: int, n: int) -> list[int]:
+    return [(x >> (8 * t)) & 0xFF for t in range(n)]
+
+
+class RnsField(Field):
+    """Field with `mul` replaced by the RNS Montgomery pipeline.
+
+    Representation-compatible with the CIOS backend (canonical positional
+    limbs at boundaries); Montgomery constant is M = prod(base A) instead
+    of 2^(16n). Works for any odd prime p with enough sub-2^13 primes —
+    BN254 (k_A=20, k_B=21) and BLS12-381 (k_A=30, k_B=31) both fit.
+    """
+
+    backend = "rns"
+
+    def __init__(self, p: int, use_pallas: bool | None = None,
+                 backend: str | None = None):
+        # the CIOS Pallas kernel computes a*b*R^-1 — wrong constant for this
+        # backend; mul() below never consults use_pallas
+        super().__init__(p, use_pallas=False)
+        if backend not in (None, "rns"):
+            raise ValueError(f"RnsField is the 'rns' backend, got {backend!r}")
+        self._build_bases(p)
+        # Montgomery constant: M, not R (see module docstring)
+        self.mont_r = self.M % p
+        self.mont_r2 = self.mont_r * self.mont_r % p
+        # int8-plane lowering maps the contractions onto the MXU; the int32
+        # single-dot lowering is bit-identical and cheaper to compile on CPU
+        self.int8_dots = _has_pallas_tpu()
+
+    # -- construction -------------------------------------------------------
+
+    def _build_bases(self, p: int) -> None:
+        primes = iter(_small_primes_desc(_PRIME_BOUND))
+        mA: list[int] = []
+        M = 1
+        while M < 4 * p:  # M >= 4p => r = T/M + c*p < (k_A + 1)*p for T < p^2
+            mA.append(next(primes))
+            M *= mA[-1]
+        kA = len(mA)
+        mB: list[int] = []
+        MB = 1
+        while MB <= 2 * (kA + 1) * p:  # r < (k_A+1)p must be < MB (CRT range)
+            mB.append(next(primes))
+            MB *= mB[-1]
+        kB = len(mB)
+        mr = next(primes)
+        assert mr > kB + 1, "redundant modulus must bound the CRT offset"
+        self.mA, self.mB, self.mr = mA, mB, mr
+        self.M, self.MB = M, MB
+        self.kA, self.kB = kA, kB
+        self.k_all = kA + kB + 1  # joint base: A ++ B ++ [m_r]
+
+        n8 = 2 * self.nlimbs  # 8-bit limb count of the positional form
+        m_all = mA + mB + [mr]
+        # positional->residue conversion: W[i, j] = 2^(8j) mod m_i
+        W = np.array(
+            [[pow(2, 8 * j, m) for j in range(n8)] for m in m_all], np.int32
+        )
+        # folded q/xi constant: xi_i = T_i * (-p^{-1} * (M/m_i)^{-1}) mod m_i
+        c1 = np.array(
+            [(-pow(p, -1, m) * pow(M // m, -1, m)) % m for m in mA], np.int32
+        )
+        # base extension A -> B ++ [m_r]: E[j, i] = (M/m_i) mod m_j
+        mB_r = mB + [mr]
+        E = np.array([[(M // mi) % mj for mi in mA] for mj in mB_r], np.int32)
+        p_modB = np.array([p % m for m in mB_r], np.int32)
+        MinvB = np.array([pow(M % m, -1, m) for m in mB_r], np.int32)
+        # exact CRT over base B: xi'_j = r_j * (MB/m_j)^{-1} mod m_j, then
+        # r = sum(xi'_j * MB/m_j) - alpha*MB with alpha recovered through m_r
+        c2 = np.array([pow(MB // m, -1, m) % m for m in mB], np.int32)
+        L_mr = np.array([(MB // m) % mr for m in mB], np.int32)
+        self._MBinv_r = int(pow(MB % mr, -1, mr))
+        n8out = (MB.bit_length() + 7) // 8
+        n8out += n8out % 2  # even, so 8->16 repack is a clean reshape
+        L8 = np.array(
+            [_limbs8(MB // m, n8out) for m in mB], np.int32
+        ).T  # (n8out, kB)
+        MB8 = np.array(_limbs8(MB, n8out), np.int32)
+        self.n8out = n8out
+        self.n16out = n8out // 2
+        # binary canonicalization ladder: r < (kA+1)p <= 2^smax * p
+        smax = (kA + 1 - 1).bit_length()
+        self._sub_consts = [
+            np.array(
+                [((p << s) >> (16 * t)) & 0xFFFF for t in range(self.n16out)],
+                np.int32,
+            )
+            for s in range(smax - 1, -1, -1)
+        ]
+        self._W, self._E, self._L8 = W, E, L8
+        self._c1, self._c2 = c1, c2
+        self._p_modB, self._MinvB = p_modB, MinvB
+        self._L_mr, self._MB8 = L_mr, MB8
+        self._m_all = np.array(m_all, np.int32)
+        self._minv_all = (1.0 / self._m_all.astype(np.float64)).astype(
+            np.float32
+        )
+
+    # -- exact modular primitives ------------------------------------------
+
+    @staticmethod
+    def _mod_rows(v, m, minv):
+        """v mod m, exact, for int32 v in [0, 2^30) and m in (2, 2^13).
+
+        Float estimate first: q = floor(f32(v)/m) is within ±1 of the true
+        quotient (relative error <= ~3*2^-24 on a ratio < 2^19, absolute
+        error < 0.1), then integer correction makes the residue exact —
+        q*m <= v + m stays inside int32.
+        """
+        import jax.numpy as jnp
+
+        q = jnp.floor(v.astype(jnp.float32) * minv).astype(jnp.int32)
+        r = v - q * m
+        r = jnp.where(r < 0, r + m, r)
+        r = jnp.where(r >= m, r - m, r)
+        return r
+
+    def _dot(self, Wnp: np.ndarray, x, exact: bool = False, mvec=None,
+             minvvec=None):
+        """Constant-matrix contraction ``W @ x`` for int32 x (d, B).
+
+        int32 mode: W split into <=7-bit planes so every partial dot stays
+        < 2^26 (depth <= 48, terms < 2^7 * 2^13); the high plane is reduced
+        mod m before the <<7 recombination unless `exact` (W < 2^8 there,
+        so the raw recombination already fits).
+        int8 mode (`self.int8_dots`): x additionally splits at bit 7 and
+        all four partial contractions run as int8 x int8 -> int32
+        `dot_general` — the MXU-native form; bit-identical results.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        d = Wnp.shape[1]
+        Wlo = jnp.asarray(Wnp & 0x7F)
+        Whi = jnp.asarray(Wnp >> 7)  # < 2^6 (W < 2^13) or <= 1 (exact, W < 2^8)
+        dn = (((1,), (0,)), ((), ()))
+
+        def dot(a, b):
+            return jax.lax.dot_general(a, b, dn,
+                                       preferred_element_type=jnp.int32)
+
+        if not self.int8_dots:
+            # terms < 2^7 * 2^13 = 2^20, depth <= 48 -> partials < 2^26
+            lo = dot(Wlo, x)
+            hi = dot(Whi, x)
+        else:
+            xl = (x & 0x7F).astype(jnp.int8)
+            xh = (x >> 7).astype(jnp.int8)  # < 2^6: residues and limbs < 2^13
+            i8 = lambda w: w.astype(jnp.int8)
+            # every partial: terms <= 127*127, depth <= 48 -> < 2^20.9
+            lo = dot(i8(Wlo), xl) + (dot(i8(Wlo), xh) << 7)
+            hi = dot(i8(Whi), xl) + (dot(i8(Whi), xh) << 7)
+        if exact:
+            # W < 2^8: hi <= depth * xmax -> lo + (hi << 7) < 2^27, exact
+            return lo + (hi << 7)
+        # congruence-preserving recombination: reduce hi first so the shift
+        # cannot overflow (hi < 2^26 -> mod -> < 2^13 -> <<7 -> < 2^20)
+        hi = self._mod_rows(hi, mvec, minvvec)
+        return self._mod_rows(lo + (hi << 7), mvec, minvvec)
+
+    # -- residue conversion -------------------------------------------------
+
+    def _split8(self, a):
+        """(nlimbs, B) uint32 16-bit limbs -> (2*nlimbs, B) int32 8-bit."""
+        import jax.numpy as jnp
+
+        a = a.astype(jnp.int32)
+        return jnp.stack([a & 0xFF, a >> 8], axis=1).reshape(
+            2 * self.nlimbs, a.shape[1]
+        )
+
+    def to_rns(self, a):
+        """Positional (nlimbs, B) uint32 -> joint-base residues (k_all, B)
+        int32 (base A rows, then base B rows, then the m_r channel)."""
+        import jax.numpy as jnp
+
+        m = jnp.asarray(self._m_all)[:, None]
+        minv = jnp.asarray(self._minv_all)[:, None]
+        return self._dot(self._W, self._split8(a), mvec=m, minvvec=minv)
+
+    def from_rns_base_b(self, rB, rr):
+        """Exact CRT: base-B residues (kB, B) + m_r channel (B,) of a value
+        v < MB -> canonical positional 16-bit limbs (n16out, B) int32.
+
+        Shenoy–Kumaresan: alpha = (sum(xi'_j * (MB/m_j)) - v) / MB is
+        recovered exactly through the redundant channel (alpha < kB < m_r),
+        then v = L8 @ xi' - alpha*MB8 in 8-bit columns, carry-propagated.
+        """
+        import jax.numpy as jnp
+
+        mB = jnp.asarray(np.array(self.mB, np.int32))[:, None]
+        mBinv = jnp.asarray(self._minv_all[self.kA : self.kA + self.kB])[:, None]
+        mr = jnp.int32(self.mr)
+        mrinv = jnp.float32(1.0 / self.mr)
+        xi = self._mod_rows(rB * jnp.asarray(self._c2)[:, None], mB, mBinv)
+        # alpha channel: per-term mod keeps the sum < kB * 2^13 < 2^19
+        terms = self._mod_rows(xi * jnp.asarray(self._L_mr)[:, None], mr, mrinv)
+        s = self._mod_rows(jnp.sum(terms, axis=0), mr, mrinv)
+        # (s - v) * MB^{-1} mod m_r; + m_r keeps the difference nonnegative
+        alpha = self._mod_rows(
+            (s - rr + mr) * jnp.int32(self._MBinv_r), mr, mrinv
+        )  # < kB exactly — the true CRT offset
+        # positional columns: exact int32 (terms < 2^21, depth kB -> < 2^26)
+        cols = self._dot(self._L8, xi, exact=True)
+        cols = cols - alpha[None, :] * jnp.asarray(self._MB8)[:, None]
+        # signed sequential carry: v - (v & 0xFF) is a multiple of 256, so
+        # the arithmetic shift is exact floor division for negatives too
+        carry = jnp.zeros_like(cols[0])
+        out8 = []
+        for t in range(self.n8out):
+            v = cols[t] + carry
+            low = v & 0xFF
+            out8.append(low)
+            carry = (v - low) >> 8
+        # top carry is 0: the reconstructed integer is < MB by CRT range
+        o8 = jnp.stack(out8)
+        return o8[0::2] + (o8[1::2] << 8)  # (n16out, B) 16-bit rows
+
+    def _cond_sub_const(self, v, cnp: np.ndarray):
+        """v - C if v >= C else v, over (n16out, B) int32 16-bit rows."""
+        import jax.numpy as jnp
+
+        borrow = jnp.zeros_like(v[0])
+        diff = []
+        for i in range(self.n16out):
+            d = v[i] - jnp.int32(int(cnp[i])) - borrow
+            borrow = (d < 0).astype(jnp.int32)
+            diff.append(d + (borrow << 16))
+        keep = borrow > 0  # borrowed past the top -> v < C
+        return jnp.stack(
+            [jnp.where(keep, v[i], diff[i]) for i in range(self.n16out)]
+        )
+
+    # -- the kernel ---------------------------------------------------------
+
+    def mul(self, a, b):
+        """RNS Montgomery product: canonical a, b (< p, positional Montgomery
+        form with constant M) -> canonical a*b*M^{-1} mod p. See module
+        docstring for the step-by-step bound/exactness argument."""
+        import jax.numpy as jnp
+
+        bsz = a.shape[1]
+        if bsz == 0:  # empty slices appear inside library combinators
+            return jnp.zeros_like(a)
+        kA, kB = self.kA, self.kB
+        m_all = jnp.asarray(self._m_all)[:, None]
+        minv_all = jnp.asarray(self._minv_all)[:, None]
+        mB_r = m_all[kA:]
+        mBinv_r = minv_all[kA:]
+
+        # 1) residues of both operands in one contraction (batch-stacked)
+        res = self._dot(
+            self._W,
+            jnp.concatenate([self._split8(a), self._split8(b)], axis=1),
+            mvec=m_all,
+            minvvec=minv_all,
+        )
+        ra, rb = res[:, :bsz], res[:, bsz:]
+        # 2) residue-wise product T mod m_i (products < 2^26)
+        d = self._mod_rows(ra * rb, m_all, minv_all)
+        # 3) folded Montgomery quotient digits in base A (< 2^26)
+        mA = m_all[:kA]
+        xi = self._mod_rows(d[:kA] * jnp.asarray(self._c1)[:, None], mA,
+                            minv_all[:kA])
+        # 4) base extension A -> B ++ [m_r]: q_hat = q + c*M, c < kA — the
+        #    offset only shifts r by c*p, absorbed by canonicalization
+        Q = self._dot(self._E, xi, mvec=mB_r, minvvec=mBinv_r)
+        # 5) r = (T + q_hat*p)/M elementwise in B ++ [m_r]:
+        #    (d + Q*p) < 2^14 after reduction; * Minv < 2^27
+        u = self._mod_rows(Q * jnp.asarray(self._p_modB)[:, None], mB_r,
+                           mBinv_r)
+        r = self._mod_rows(
+            (d[kA:] + u) * jnp.asarray(self._MinvB)[:, None], mB_r, mBinv_r
+        )
+        # 6) exact CRT back to positional form; r < (kA+1)p < MB
+        v16 = self.from_rns_base_b(r[:kB], r[kB])
+        # 7) canonicalize r < 2^smax * p down to < p (binary ladder)
+        for cnp in self._sub_consts:
+            v16 = self._cond_sub_const(v16, cnp)
+        # value < p fits the field's limb count; higher rows are zero
+        return v16[: self.nlimbs].astype(jnp.uint32)

@@ -151,6 +151,8 @@ class ServiceParams:
     batch_size: int = 0  # shared-launch lanes; 0 -> global batch_size
     spawn_stagger_ms: float = 0.0  # delay between session spawns
     period_ms: float = 10.0  # gossip period of the session nodes
+    fp_backend: str = ""  # Field modmul kernel for the service's verify
+    # plane ("cios"/"rns", ops/fp.py backend seam); "" -> global fp_backend
 
     def enabled(self) -> bool:
         return self.sessions > 0
@@ -318,6 +320,10 @@ class SimConfig:
     # device-mesh width for the verification plane (>1 = sharded kernels;
     # on chip-less hosts virtual CPU devices are forced to this count)
     mesh_devices: int = 1
+    # Field modmul kernel for device schemes: "cios" (VPU Pallas kernel) or
+    # "rns" (residue-number-system MXU pipeline, ops/rns.py); plumbed
+    # node -> new_scheme -> models/*_jax.py -> ops/curve.py -> ops/fp.py
+    fp_backend: str = "cios"
     debug: bool = False
     # live telemetry plane (core/metrics.py): every node process serves
     # /metrics + /healthz + /readyz on its own port (allocated by the
@@ -368,6 +374,7 @@ def load_config(path: str) -> SimConfig:
         batch_size=int(raw.get("batch_size", 16)),
         shared_verifier=bool(raw.get("shared_verifier", False)),
         mesh_devices=int(raw.get("mesh_devices", 1)),
+        fp_backend=str(raw.get("fp_backend", "cios")),
         debug=bool(raw.get("debug", False)),
         metrics=bool(raw.get("metrics", False)),
         metrics_linger_s=float(raw.get("metrics_linger_s", 0.0)),
@@ -404,7 +411,15 @@ def load_config(path: str) -> SimConfig:
         batch_size=int(sv.get("batch_size", 0)),
         spawn_stagger_ms=float(sv.get("spawn_stagger_ms", 0.0)),
         period_ms=float(sv.get("period_ms", 10.0)),
+        fp_backend=str(sv.get("fp_backend", "")),
     )
+    if cfg.fp_backend not in ("cios", "rns") or cfg.service.fp_backend not in (
+        "", "cios", "rns",
+    ):
+        raise ValueError(
+            f"fp_backend must be 'cios' or 'rns', got "
+            f"{cfg.fp_backend!r} / service {cfg.service.fp_backend!r}"
+        )
     so = raw.get("soak", {})
     cfg.soak = SoakParams(
         duration_s=float(so.get("duration_s", 90.0)),
@@ -507,6 +522,7 @@ def dump_config(cfg: SimConfig) -> str:
         f"batch_size = {cfg.batch_size}",
         f"shared_verifier = {str(cfg.shared_verifier).lower()}",
         f"mesh_devices = {cfg.mesh_devices}",
+        f'fp_backend = "{cfg.fp_backend}"',
         f"debug = {str(cfg.debug).lower()}",
         f"metrics = {str(cfg.metrics).lower()}",
         f"metrics_linger_s = {cfg.metrics_linger_s}",
@@ -547,6 +563,7 @@ def dump_config(cfg: SimConfig) -> str:
             f"batch_size = {cfg.service.batch_size}",
             f"spawn_stagger_ms = {cfg.service.spawn_stagger_ms}",
             f"period_ms = {cfg.service.period_ms}",
+            f'fp_backend = "{cfg.service.fp_backend}"',
         ]
     if cfg.soak != SoakParams():  # non-default soak shapes round-trip
         lines += [

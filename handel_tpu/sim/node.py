@@ -100,7 +100,11 @@ async def run_node_process(args) -> int:
     scheme = new_scheme(
         cfg.scheme,
         **(
-            {"batch_size": cfg.batch_size, "mesh_devices": cfg.mesh_devices}
+            {
+                "batch_size": cfg.batch_size,
+                "mesh_devices": cfg.mesh_devices,
+                "fp_backend": cfg.fp_backend,
+            }
             if is_device_scheme(cfg.scheme)
             else {}
         ),

@@ -78,7 +78,16 @@ SIDE_METRICS = {
     # stake-weight drill
     "geo_weighted_ttt_s": "lower",
     "shed_rate": "lower",
+    # Fp-backend marginal modmul throughput (bench.py _fp_microbench /
+    # ops/fp.py chained_marginal): captured once per Field backend
+    # (CIOS, RNS) under the same chained-dispatch methodology
+    "mont_muls_per_s": "higher",
 }
+
+# Metrics that exist once per Field backend. Their comparison key grows a
+# "/<fp_backend>" suffix so a CIOS row is never judged against an RNS row
+# (the per-backend like-for-like rule, same spirit as tpu-vs-cpu refusal).
+PER_FP_BACKEND = {"mont_muls_per_s"}
 
 
 def normalize(obj: dict) -> dict | None:
@@ -89,28 +98,43 @@ def normalize(obj: dict) -> dict | None:
     if "parsed" in obj or "rc" in obj:  # driver wrapper
         rec = obj.get("parsed")
         return rec if isinstance(rec, dict) else None
-    return obj if "metric" in obj else None
+    # "records" alone is enough: a container of nested per-fp-backend
+    # captures with no headline of its own is still a bench record
+    return obj if "metric" in obj or "records" in obj else None
 
 
 def extract_metrics(rec: dict) -> dict[tuple[str, str], float]:
     """{(metric name, backend): value} for every comparable number in one
     record. Records without a backend tag (old CPU smokes) are keyed under
     "cpu" only when their metric name says so, else skipped entirely —
-    an unlabeled number cannot be compared like-for-like."""
+    an unlabeled number cannot be compared like-for-like. PER_FP_BACKEND
+    metrics key as "<backend>/<fp_backend>"; a "records" list of nested
+    captures is walked with the same rules."""
+    out: dict[tuple[str, str], float] = {}
+    # nested per-fp-backend captures (bench.py _fp_microbench "records")
+    for sub in rec.get("records") or []:
+        if isinstance(sub, dict):
+            out.update(extract_metrics(sub))
     backend = rec.get("backend")
     if not backend:
         backend = "cpu" if "cpu_smoke" in str(rec.get("metric", "")) else None
     if not backend:
-        return {}
-    out: dict[tuple[str, str], float] = {}
+        return out
+
+    def keyed(metric: str) -> str:
+        fp = rec.get("fp_backend")
+        if metric in PER_FP_BACKEND and fp:
+            return f"{backend}/{fp}"
+        return backend
+
     name, value = rec.get("metric"), rec.get("value")
     if name and isinstance(value, (int, float)):
         if not rec.get("forced_shape") and not rec.get("invalid_measurement"):
-            out[(str(name), backend)] = float(value)
+            out[(str(name), keyed(str(name)))] = float(value)
     for key in SIDE_METRICS:
         v = rec.get(key)
         if isinstance(v, (int, float)):
-            out[(key, backend)] = float(v)
+            out[(key, keyed(key))] = float(v)
     return out
 
 

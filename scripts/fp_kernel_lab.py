@@ -20,9 +20,15 @@ arithmetic into full-width (nlimbs, B) ops:
     unrolled as full-width multiply-accumulates against scalar limb constants
     split 8-bit to keep every column < 2^24 in uint32.
 
-Both are validated against the production path (itself oracle-validated in
-tests/test_fp_jax.py) over random inputs, then timed. Run on the target
-backend:
+The lab also races the RNS backend (`Field(backend="rns")`, ops/rns.py) —
+the MXU-shaped dot_general formulation — as a first-class candidate.
+
+Every candidate is validated against its own Montgomery-constant oracle
+(the production path is itself oracle-validated in tests/test_fp_jax.py),
+then timed with the SHARED chained-dispatch marginal helper
+(`handel_tpu.ops.fp.chained_marginal` — the same methodology behind
+`_throughput_bench` and scripts/mxu_limb_lab.py, so every figure in
+results/fp_microbench.json is like-for-like). Run on the target backend:
 
     python scripts/fp_kernel_lab.py [batch] [--variants v1,v2,...]
 """
@@ -31,7 +37,6 @@ from __future__ import annotations
 
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -44,7 +49,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from handel_tpu.ops import bn254_ref as bn
-from handel_tpu.ops.fp import LIMB_BITS, LIMB_MASK, Field, _int_to_limbs
+from handel_tpu.ops.fp import (
+    LIMB_BITS,
+    LIMB_MASK,
+    Field,
+    _int_to_limbs,
+    chained_marginal,
+)
 
 _LANE = 128
 
@@ -235,6 +246,10 @@ class LabField:
 
 
 def validate(F: Field, fn, bsz: int = 256, seed: int = 7) -> None:
+    """Exactness vs the bigint oracle, under the candidate field's OWN
+    Montgomery constant (mont_r is R mod p for CIOS-family candidates, the
+    base-A product M mod p for the RNS backend — pow(mont_r, -1, p) is the
+    right quotient either way)."""
     rng = np.random.default_rng(seed)
     xs = [int(rng.integers(0, 1 << 62)) * int(rng.integers(0, 1 << 62)) % F.p
           for _ in range(bsz)]
@@ -243,21 +258,21 @@ def validate(F: Field, fn, bsz: int = 256, seed: int = 7) -> None:
     a = F.pack(xs, mont=False)
     b = F.pack(ys, mont=False)
     got = F.unpack(np.asarray(jax.device_get(fn(a, b))), mont=False)
-    R_inv = pow(1 << (LIMB_BITS * F.nlimbs), -1, F.p)
-    want = [x * y * R_inv % F.p for x, y in zip(xs, ys)]
+    m_inv = pow(F.mont_r, -1, F.p)
+    want = [x * y * m_inv % F.p for x, y in zip(xs, ys)]
     bad = [k for k in range(bsz) if got[k] != want[k]]
     assert not bad, f"mismatch at lanes {bad[:5]} (of {len(bad)})"
 
 
 def bench(name: str, fn, a, b, trials: int = 5) -> float:
-    fn(a, b).block_until_ready()
-    best = float("inf")
-    for _ in range(trials):
-        t0 = time.perf_counter()
-        fn(a, b).block_until_ready()
-        best = min(best, time.perf_counter() - t0)
-    rate = a.shape[1] / best
-    print(f"  {name:28s} {rate/1e6:8.2f}M muls/s  ({best*1e3:.2f} ms)")
+    """Chained-dispatch marginal rate (shared methodology — see
+    chained_marginal): a naive time-one-call loop here once measured the
+    ~60 ms tunnel instead of the kernel."""
+    rate, _floor = chained_marginal(fn, a, b, k1=4, k2=20, trials=trials)
+    if rate is None:
+        print(f"  {name:28s} marginal slope unmeasurable (timing noise)")
+        return 0.0
+    print(f"  {name:28s} {rate/1e6:8.2f}M muls/s marginal")
     return rate
 
 
@@ -271,40 +286,45 @@ def main() -> int:
     on_tpu = jax.default_backend() != "cpu"
     print(f"backend={jax.default_backend()} batch={batch}")
 
-    # (name, bench_fn, validate_fn): pallas builds are shape-specialized to
-    # the bench batch with a fixed grid, so they are validated through a
-    # SEPARATE small-batch build of the same body — validating the bench
-    # build with 256-wide inputs would shape-mismatch every pallas variant
-    # out of the race (advisor finding, r04). One shared small-batch build
-    # per body: the tile variants share algebra, so revalidating per tile
-    # would only re-pay compiles. Non-pallas entries validate the bench fn
-    # itself (shape-polymorphic).
+    # (name, bench_fn, validate_fn, field): pallas builds are
+    # shape-specialized to the bench batch with a fixed grid, so they are
+    # validated through a SEPARATE small-batch build of the same body —
+    # validating the bench build with 256-wide inputs would shape-mismatch
+    # every pallas variant out of the race (advisor finding, r04). One
+    # shared small-batch build per body: the tile variants share algebra,
+    # so revalidating per tile would only re-pay compiles. Non-pallas
+    # entries validate the bench fn itself (shape-polymorphic). `field`
+    # carries each candidate's Montgomery constant into validate().
     prod = jax.jit(F.mul)
-    candidates: list[tuple[str, object, object]] = [
-        ("prod(Field.mul)", prod, prod)
+    F_rns = Field(bn.P, backend="rns")
+    rns = jax.jit(F_rns.mul)
+    candidates: list[tuple[str, object, object, Field]] = [
+        ("prod(Field.mul)", prod, prod, F),
+        ("rns(Field backend)", rns, rns, F_rns),
     ]
     for nm, body in (
         ("cios_fullwidth", lab.cios_fullwidth_body),
         ("separated", lab.separated_body),
     ):
         xla_fn = lab.jit_xla(body)
-        candidates.append((f"xla:{nm}", xla_fn, xla_fn))
+        candidates.append((f"xla:{nm}", xla_fn, xla_fn, F))
         if on_tpu:
             vfn = lab.jit_pallas(body, 256, 256)
             for tile in (256, 512, 1024, 2048):
                 candidates.append(
-                    (f"pallas:{nm}:t{tile}", lab.jit_pallas(body, batch, tile), vfn)
+                    (f"pallas:{nm}:t{tile}", lab.jit_pallas(body, batch, tile),
+                     vfn, F)
                 )
 
-    for nm, _fn, vfn in candidates:
+    for nm, _fn, vfn, cf in candidates:
         try:
-            validate(F, vfn)
+            validate(cf, vfn)
             print(f"  {nm:28s} validate: OK")
         except Exception as e:  # noqa: BLE001
             print(f"  {nm:28s} validate: FAIL ({type(e).__name__}: {e})")
             candidates = [c for c in candidates if c[0] != nm]
     print("-- timing --")
-    for nm, fn, _vfn in candidates:
+    for nm, fn, _vfn, _cf in candidates:
         try:
             bench(nm, fn, a, b)
         except Exception as e:  # noqa: BLE001

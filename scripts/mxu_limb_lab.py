@@ -21,10 +21,16 @@ with numbers either way:
                       int8 MXU rate, for computing what ANY
                       MXU-formulated mul could at best achieve.
 
-Marginal methodology follows Field._throughput_bench: k-deep dependent
-chains inside one executable so the ~60 ms tunnel dispatch floor
-cancels. Results land in results/fp_microbench.json under "mxu_lab"
-when run with --persist.
+  * rns             — the shipped answer to this lab's question:
+                      `Field(backend="rns")` (ops/rns.py), residues +
+                      base-extension as constant-matrix dot_general
+                      contractions — deep-K MXU shape, no outer product.
+
+Marginal methodology IS Field._throughput_bench's, via the shared
+`handel_tpu.ops.fp.chained_marginal` helper (one copy, imported here and
+by scripts/fp_kernel_lab.py): k-deep dependent chains inside one
+executable so the ~60 ms tunnel dispatch floor cancels. Results land in
+results/fp_microbench.json under "mxu_lab" when run with --persist.
 
     python scripts/mxu_limb_lab.py [batch] [--persist]
 """
@@ -48,7 +54,7 @@ import numpy as np
 
 from bench import write_json_atomic
 from handel_tpu.ops import bn254_ref as bn
-from handel_tpu.ops.fp import LIMB_BITS, Field
+from handel_tpu.ops.fp import LIMB_BITS, Field, chained_marginal
 
 N8 = 32  # 8-bit limbs for 256 bits
 
@@ -149,41 +155,14 @@ def make_outer8_mont(F: Field):
 
 
 def marginal(fn, a, b, k1=4, k2=20, trials=5):
-    """Chained-mul slope between k1- and k2-deep chains, muls/s.
-
-    Best-of-trials PER CHAIN DEPTH first, one slope after — matching
-    Field._throughput_bench. A single contended trial then only inflates
-    that trial's time (discarded by min), instead of poisoning the slope
-    the way a min over per-trial slopes would (one noise-inverted trial
-    used to force the whole measurement to None).
-    """
-
-    def chain(k):
-        @jax.jit
-        def run(a, b):
-            acc = a
-            for _ in range(k):
-                acc = fn(acc, b)
-            return acc
-
-        return run
-
-    f1, f2 = chain(k1), chain(k2)
-    jax.block_until_ready(f1(a, b))
-    jax.block_until_ready(f2(a, b))
-    best1 = best2 = float("inf")
-    for _ in range(trials):
-        t0 = time.perf_counter()
-        jax.block_until_ready(f1(a, b))
-        t1 = time.perf_counter()
-        jax.block_until_ready(f2(a, b))
-        t2 = time.perf_counter()
-        best1 = min(best1, t1 - t0)
-        best2 = min(best2, t2 - t1)
-    slope = (best2 - best1) / (k2 - k1)
-    # a fully-contended run can still lose the slope; report the failure
-    # as None (JSON null), never NaN, so the artifact stays valid
-    return a.shape[1] / slope if slope > 0 else None
+    """Lab-depth wrapper over the shared `chained_marginal` (one copy of
+    the chained-dispatch methodology for every fp_microbench figure).
+    Returns muls/s, or None (JSON null, never NaN) when the slope is lost
+    to timing noise — best-of-trials per chain depth happens inside the
+    shared helper, so one contended trial only inflates that trial's time
+    instead of poisoning the slope."""
+    rate, _floor = chained_marginal(fn, a, b, k1=k1, k2=k2, trials=trials)
+    return rate
 
 
 def main() -> int:
@@ -218,11 +197,27 @@ def main() -> int:
         bad = np.nonzero((got != want).any(axis=0))[0][:4]
         print(f"  first mismatching lanes: {bad}")
         return 1
+    # rns gate: its Montgomery constant is M (not R), so compare against
+    # the bigint oracle under its own constant rather than F.mul's output
+    F_rns = Field(bn.P, backend="rns")
+    got_r = F_rns.unpack(
+        jax.device_get(jax.jit(F_rns.mul)(a[:, :256], b[:, :256])), mont=False
+    )
+    m_inv = pow(F_rns.mont_r, -1, F.p)
+    want_r = [x * y * m_inv % F.p
+              for x, y in zip(vals_a[:256], vals_b[:256])]
+    ok_r = got_r == want_r
+    print(f"rns vs oracle agreement: {ok_r}")
+    if not ok_r:
+        bad = [k for k in range(256) if got_r[k] != want_r[k]][:4]
+        print(f"  first mismatching lanes: {bad}")
+        return 1
 
     out = {"batch": batch, "backend": jax.default_backend()}
     for key, label, fn in (
         ("prod_muls_per_s", "prod (Pallas CIOS)", F.mul),
         ("outer8_muls_per_s", "outer8_f32 (einsum)", mont8),
+        ("rns_muls_per_s", "rns (dot_general)", F_rns.mul),
     ):
         r = marginal(fn, a, b)
         out[key] = r
@@ -318,7 +313,8 @@ def main() -> int:
             # a lost slope (None) must not erase a previously captured valid
             # figure for the same key (bench.py keeps its artifact on
             # rate<=0 for the same reason)
-            for k in ("prod_muls_per_s", "outer8_muls_per_s"):
+            for k in ("prod_muls_per_s", "outer8_muls_per_s",
+                      "rns_muls_per_s"):
                 if entry.get(k) is None and prev.get(k) is not None:
                     entry[k] = prev[k]
                     # provenance: the carried figure was measured under the

@@ -1,0 +1,215 @@
+"""RNS-backend CI gate (fast tier, CPU XLA path — ISSUE 14 acceptance).
+
+Four checks, each a hard exit-nonzero failure:
+
+1. Bit-exactness: a seeded batch of products (random + edge operands,
+   including both operands at p-1) through `Field(backend="rns")` must
+   match the CIOS kernel BIT-FOR-BIT at the canonical boundary — the
+   representation the two backends contract to agree on (their Montgomery
+   constants differ: R = 2^16n vs the base-A product M).
+2. CRT round-trip: to_rns -> from_rns_base_b is exact over the full
+   16n-bit positional range (top value 2^256-1 exercises the Shenoy
+   alpha-recovery channel at its limit).
+3. Backend plumbing: fp_backend survives TOML load/dump round-trip,
+   rejects junk values, and reaches the constructed Field through
+   new_scheme (TOML -> SimConfig -> scheme kwargs -> Curves -> Field).
+4. bench_check dry-run: constructed per-fp-backend `mont_muls_per_s`
+   records flow through scripts/bench_check.py keyed as
+   "<backend>/<fp_backend>" — an RNS row gates only against RNS history,
+   and a CIOS-only history yields a cross-backend refusal, never a
+   judgment.
+
+On real hardware the MXU lab (scripts/mxu_limb_lab.py --persist) captures
+the actual marginal figures; this gate is the CPU-only stand-in that keeps
+the kernel and the gating plumbing honest on every commit.
+
+Usage: python scripts/rns_smoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def check_bit_exact() -> None:
+    import numpy as np
+
+    from handel_tpu.ops import bn254_ref as bn
+    from handel_tpu.ops.fp import Field
+
+    Fr = Field(bn.P, backend="rns")
+    Fc = Field(bn.P, use_pallas=False)
+    rng = np.random.default_rng(2024)
+    xs = [int.from_bytes(rng.bytes(32), "little") % bn.P for _ in range(12)]
+    xs += [0, 1, bn.P - 1, bn.P - 1]
+    ys = list(reversed(xs))
+
+    # correctness vs the bigint oracle
+    got = Fr.unpack(Fr.mul(Fr.pack(xs), Fr.pack(ys)))
+    want = [x * y % bn.P for x, y in zip(xs, ys)]
+    assert got == want, "rns mul disagrees with the bigint oracle"
+
+    # canonical-boundary limbs bitwise equal to CIOS
+    plain = Fr.pack(xs, mont=False)
+    assert np.array_equal(
+        np.asarray(plain), np.asarray(Fc.pack(xs, mont=False))
+    ), "canonical pack differs between backends"
+    out_r = Fr.from_mont(Fr.mul(Fr.to_mont(plain), Fr.to_mont(plain)))
+    out_c = Fc.from_mont(Fc.mul(Fc.to_mont(plain), Fc.to_mont(plain)))
+    assert np.array_equal(np.asarray(out_r), np.asarray(out_c)), (
+        "boundary limbs not bit-identical between rns and cios"
+    )
+    print(f"rns_smoke: bit-exact vs cios over {len(xs)} seeded products")
+
+
+def check_crt_roundtrip() -> None:
+    import jax.numpy as jnp
+    import numpy as np
+
+    from handel_tpu.ops import bn254_ref as bn
+    from handel_tpu.ops.fp import Field
+
+    F = Field(bn.P, backend="rns")
+    n = F.nlimbs
+    tops = [(1 << (16 * n)) - 1, bn.P, bn.P + 1, 12345, 0]
+    arr = np.zeros((n, len(tops)), np.uint32)
+    for j, v in enumerate(tops):
+        for i in range(n):
+            arr[i, j] = (v >> (16 * i)) & 0xFFFF
+    r = F.to_rns(jnp.asarray(arr))
+    v16 = np.asarray(
+        F.from_rns_base_b(r[F.kA : F.kA + F.kB], r[F.kA + F.kB])
+    )
+    for j, v in enumerate(tops):
+        rec = sum(int(v16[i, j]) << (16 * i) for i in range(F.n16out))
+        assert rec == v, f"CRT round-trip broke at {v:#x}"
+    print(f"rns_smoke: CRT round-trip exact over {len(tops)} values "
+          f"(top {tops[0].bit_length()} bits)")
+
+
+def check_toml_plumbing() -> None:
+    from handel_tpu.models.registry import new_scheme
+    from handel_tpu.ops.rns import RnsField
+    from handel_tpu.sim.config import dump_config, load_config
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "cfg.toml")
+        with open(path, "w") as f:
+            f.write('scheme = "bn254-jax"\nfp_backend = "rns"\n'
+                    '[service]\nfp_backend = "cios"\n')
+        cfg = load_config(path)
+        assert cfg.fp_backend == "rns"
+        assert cfg.service.fp_backend == "cios"
+        dumped = dump_config(cfg)
+        assert 'fp_backend = "rns"' in dumped
+        bad = os.path.join(d, "bad.toml")
+        with open(bad, "w") as f:
+            f.write('fp_backend = "vpu"\n')
+        try:
+            load_config(bad)
+        except ValueError:
+            pass
+        else:
+            raise AssertionError("junk fp_backend accepted")
+    sch = new_scheme(
+        "bn254-jax", batch_size=4, mesh_devices=1, fp_backend="rns",
+        warmup=False,
+    )
+    F = sch.constructor.curves.F
+    assert type(F) is RnsField and F.backend == "rns"
+    print("rns_smoke: fp_backend plumbed TOML -> SimConfig -> Field")
+
+
+def check_bench_check_dry_run() -> None:
+    def rec(fp_backend: str, value: float) -> dict:
+        return {
+            "metric": "mont_muls_per_s",
+            "value": value,
+            "unit": "M muls/s",
+            "backend": "cpu",
+            "fp_backend": fp_backend,
+            "batch": 1024,
+            "captured_at": f"2026-01-01T00:00:0{int(value) % 10}Z",
+        }
+
+    with tempfile.TemporaryDirectory() as d:
+        for i, (cios, rns) in enumerate([(350.0, 420.0), (360.0, 410.0)]):
+            with open(os.path.join(d, f"BENCH_h{i}.json"), "w") as f:
+                json.dump({"records": [rec("cios", cios), rec("rns", rns)]},
+                          f)
+        fresh = os.path.join(d, "fresh.json")
+        with open(fresh, "w") as f:
+            # rns holds steady; cios "regresses" — dry-run must key them
+            # separately and never let the cios row judge the rns row
+            json.dump({"records": [rec("cios", 100.0), rec("rns", 415.0)]},
+                      f)
+        report_path = os.path.join(d, "report.json")
+        r = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(REPO, "scripts", "bench_check.py"),
+                "--history", os.path.join(d, "BENCH_*.json"),
+                "--fresh", fresh,
+                "--dry-run", "--json", report_path,
+            ],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert r.returncode == 0, r.stderr[-2000:]
+        report = json.load(open(report_path))
+        keys = {
+            (e["metric"], e["backend"])
+            for sec in ("regressions", "improved", "ok")
+            for e in report[sec]
+        }
+        assert ("mont_muls_per_s", "cpu/cios") in keys, report
+        assert ("mont_muls_per_s", "cpu/rns") in keys, report
+        regressed = {e["backend"] for e in report["regressions"]}
+        assert regressed == {"cpu/cios"}, (
+            f"per-fp-backend keying broken: {report}"
+        )
+
+        # cios-only history must REFUSE to judge an rns row
+        fresh2 = os.path.join(d, "fresh2.json")
+        with open(fresh2, "w") as f:
+            json.dump(rec("rns", 1.0), f)
+        for i in range(2):
+            with open(os.path.join(d, f"CONLY_h{i}.json"), "w") as f:
+                json.dump(rec("cios", 350.0 + i), f)
+        r2 = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(REPO, "scripts", "bench_check.py"),
+                "--history", os.path.join(d, "CONLY_*.json"),
+                "--fresh", fresh2, "--json", report_path,
+            ],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert r2.returncode == 0, (r2.stdout, r2.stderr[-2000:])
+        report2 = json.load(open(report_path))
+        assert report2["skipped"] and "cross-backend" in (
+            report2["skipped"][0]["reason"]
+        ), report2
+    print("rns_smoke: bench_check keys mont_muls_per_s per fp_backend "
+          "(cross-backend judgment refused)")
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    check_bit_exact()
+    check_crt_roundtrip()
+    check_toml_plumbing()
+    check_bench_check_dry_run()
+    print("rns_smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

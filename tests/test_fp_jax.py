@@ -3,6 +3,16 @@
 SURVEY.md §7 step 1: property tests of the Montgomery limb kernels against
 ops/bn254_ref.py. Runs on CPU (pure-XLA path); the Pallas TPU path shares the
 same `_mul_cols` body and is exercised by bench.py on hardware.
+
+The `F` fixture is parametrized over the Field backend seam (ops/fp.py):
+every property runs against BOTH the CIOS kernel and the RNS Montgomery
+pipeline (ops/rns.py). The two backends use different Montgomery constants
+(R vs the base-A product M), so properties are stated on unpacked integers
+/ canonical boundary limbs — the representation the backends contract to
+agree on bit-exactly. RNS-specific edge cases (operands near p, residue
+overflow bounds, CRT exactness at the pairing-line boundary) follow at the
+bottom; compile-cheap RNS unit checks live in the fast tier
+(tests/test_rns.py, scripts/rns_smoke.py).
 """
 
 import random
@@ -23,9 +33,9 @@ from handel_tpu.ops.fp import Field, LIMB_MASK
 rng = random.Random(99)
 
 
-@pytest.fixture(scope="module")
-def F():
-    return Field(bn.P, use_pallas=False)
+@pytest.fixture(scope="module", params=["cios", "rns"])
+def F(request):
+    return Field(bn.P, use_pallas=False, backend=request.param)
 
 
 def rand_elems(k):
@@ -139,13 +149,110 @@ def test_random_fuzz_mul(F):
     assert F.unpack(out) == [x * y % bn.P for x, y in zip(xs, ys)]
 
 
-def test_bls12_381_field_params():
+@pytest.mark.parametrize("backend", ["cios", "rns"])
+def test_bls12_381_field_params(backend):
     # the same engine must serve BLS12-381's 381-bit prime (24 limbs)
     p381 = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
-    F381 = Field(p381, use_pallas=False)
+    F381 = Field(p381, use_pallas=False, backend=backend)
     assert F381.nlimbs == 24
     xs, ys = [rng.randrange(p381) for _ in range(4)], [
         rng.randrange(p381) for _ in range(4)
     ]
     out = jax.jit(F381.mul)(F381.pack(xs), F381.pack(ys))
     assert F381.unpack(out) == [x * y % p381 for x, y in zip(xs, ys)]
+
+
+# -- RNS-specific edges (ops/rns.py) ------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def Frns():
+    return Field(bn.P, backend="rns")
+
+
+@pytest.fixture(scope="module")
+def Fcios():
+    return Field(bn.P, use_pallas=False)
+
+
+def test_rns_operands_near_p(Frns, Fcios):
+    """The canonicalization ladder's worst inputs: both operands at the top
+    of the field, where r = (T + q_hat*p)/M approaches the (kA+1)p bound
+    and every binary conditional-subtract step fires. Boundary limbs must
+    stay bit-identical to the CIOS backend."""
+    near = [bn.P - 1 - k for k in range(6)] + [1, 2]
+    a_r, b_r = Frns.pack(near), Frns.pack(list(reversed(near)))
+    got = Frns.unpack(jax.jit(Frns.mul)(a_r, b_r))
+    want = [x * y % bn.P for x, y in zip(near, reversed(near))]
+    assert got == want
+    # canonical-boundary bit-exactness vs the CIOS oracle
+    plain = Frns.pack(near, mont=False)
+    r_out = jax.jit(lambda a: Frns.from_mont(Frns.mul(Frns.to_mont(a),
+                                                      Frns.to_mont(a))))(plain)
+    c_out = jax.jit(lambda a: Fcios.from_mont(Fcios.mul(Fcios.to_mont(a),
+                                                        Fcios.to_mont(a))))(plain)
+    assert np.array_equal(np.asarray(r_out), np.asarray(c_out))
+
+
+def test_rns_residue_overflow_bounds(Frns):
+    """Construction-time range invariants the int32 exactness proofs rest
+    on, plus a mul where every residue row sits at its maximum (operands
+    whose residues are m_i - 1 for many i): no intermediate may exceed the
+    float-assisted reduction's 2^30 domain."""
+    F = Frns
+    assert F.M >= 4 * F.p  # r < (kA+1)p bound
+    assert F.MB > 2 * (F.kA + 1) * F.p  # second-extension CRT range
+    assert F.mr > F.kB + 1  # exact alpha recovery channel
+    assert all(m < (1 << 13) for m in F.mA + F.mB + [F.mr])
+    assert (1 << 16 * F.nlimbs) <= F.MB  # any 16n-bit value CRT-round-trips
+    # operands ≡ -1 mod every base-A prime: maximal residues through the
+    # product, xi, and base-extension paths
+    import math
+
+    prodA = F.M
+    x = prodA - 1  # < M but > p — reduce into the field first
+    vals = [x % F.p, (prodA // 2) % F.p, (F.MB - 1) % F.p, F.p - 1]
+    a = F.pack(vals)
+    b = F.pack([F.p - 1] * len(vals))
+    got = F.unpack(jax.jit(F.mul)(a, b))
+    assert got == [v * (F.p - 1) % F.p for v in vals]
+    assert math.gcd(F.M, F.MB * F.mr) == 1  # bases coprime (CRT validity)
+
+
+def test_rns_crt_roundtrip_full_range(Frns):
+    """to_rns -> from_rns_base_b is EXACT over the full 16n-bit positional
+    range (not just < p): the Shenoy alpha recovery must hold at the very
+    top, 2^256 - 1."""
+    F = Frns
+    n = F.nlimbs
+    tops = [(1 << (16 * n)) - 1, F.p, F.p + 1, (1 << (16 * n)) - F.p, 12345]
+    arr = np.zeros((n, len(tops)), np.uint32)
+    for j, v in enumerate(tops):
+        for i in range(n):
+            arr[i, j] = (v >> (16 * i)) & 0xFFFF
+    a = jnp.asarray(arr)
+    r = jax.jit(F.to_rns)(a)
+    v16 = jax.jit(
+        lambda rB, rr: F.from_rns_base_b(rB, rr)
+    )(r[F.kA : F.kA + F.kB], r[F.kA + F.kB])
+    got = np.asarray(v16)
+    for j, v in enumerate(tops):
+        rec = sum(int(got[i, j]) << (16 * i) for i in range(F.n16out))
+        assert rec == v, f"CRT round-trip broke at {v:#x}"
+
+
+def test_rns_exact_at_pairing_line_boundary(Frns, Fcios):
+    """The pairing consumes positional form at line evaluations: chains of
+    mul -> add -> mul (each mul paying a full CRT reconstruction). A
+    sparse-line-shaped expression l = a*b + c*d + e must agree bit-exactly
+    with the CIOS backend at the canonical boundary after EVERY hop, not
+    just at the end."""
+    vals = rand_elems(8)
+    packs = {}
+    for name, Fx in (("rns", Frns), ("cios", Fcios)):
+        a, b = Fx.pack(vals), Fx.pack(list(reversed(vals)))
+        t1 = Fx.mul(a, b)
+        t2 = Fx.mul(Fx.add(t1, a), Fx.sub(t1, b))
+        line = Fx.add(Fx.mul(t2, t1), a)
+        packs[name] = [Fx.unpack(t) for t in (t1, t2, line)]
+    assert packs["rns"] == packs["cios"]

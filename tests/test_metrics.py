@@ -574,6 +574,83 @@ def test_bench_probe_verdict_cached_per_host(monkeypatch, tmp_path):
     assert bench._cached_probe_failure() is None
 
 
+def test_bench_probe_cache_path_survives_tmpdir_churn(monkeypatch, tmp_path):
+    """The default probe-cache path must NOT live in tempfile.gettempdir():
+    drivers point TMPDIR at a fresh per-round directory, so a verdict
+    written there evaporates between rounds and the ~8.5 min ladder
+    replays every round of an outage (BENCH_r05 — the PR 12 cache existed
+    but was never found again). The default is keyed to the stable
+    per-user cache root instead, so two rounds with different TMPDIRs
+    resolve the SAME file."""
+    sys.path.insert(0, REPO)
+    import importlib
+    import tempfile
+
+    import bench
+
+    monkeypatch.delenv("HANDEL_TPU_PROBE_CACHE", raising=False)
+    monkeypatch.setenv("XDG_CACHE_HOME", str(tmp_path / "cache-root"))
+    monkeypatch.setenv("TMPDIR", str(tmp_path / "round-a"))
+    (tmp_path / "round-a").mkdir()
+    (tmp_path / "round-b").mkdir()
+    tempfile.tempdir = None  # force gettempdir() to re-read TMPDIR
+    try:
+        path_a = bench._probe_cache_path()
+        monkeypatch.setenv("TMPDIR", str(tmp_path / "round-b"))
+        tempfile.tempdir = None
+        path_b = bench._probe_cache_path()
+    finally:
+        tempfile.tempdir = None
+    assert path_a == path_b, "probe verdict must survive TMPDIR churn"
+    assert str(tmp_path / "cache-root") in path_a
+    # and recording actually creates the (previously absent) cache dir
+    bench._record_probe_verdict(False)
+    assert bench._cached_probe_failure() is not None
+
+
+def test_bench_cached_unreachable_skips_ladder_to_cpu_fallback(tmp_path):
+    """End to end: a fresh cached 'unreachable' verdict makes bench.py skip
+    the retry ladder entirely and drop straight to the CPU fallback path,
+    re-emitting the persisted TPU artifact (source == "persisted") — the
+    outage round costs seconds, not ~8.5 min of backoff."""
+    sys.path.insert(0, REPO)
+    import subprocess
+    import time as _time
+
+    cache = tmp_path / "probe_verdict.json"
+    cache.write_text(json.dumps(
+        {"reachable": False, "checked_at": _time.time()}
+    ))
+    artifact = tmp_path / "bench_tpu.json"
+    artifact.write_text(json.dumps({
+        "metric": "4096sig_batch_verify_p50_ms", "value": 101.3,
+        "unit": "ms", "vs_baseline": 8.88, "backend": "tpu",
+        "captured_at": "2026-08-01T00:00:00Z",
+    }))
+    env = os.environ.copy()
+    env.pop("JAX_PLATFORMS", None)  # probe path must actually be consulted
+    env.pop("HANDEL_TPU_PLATFORM", None)
+    env.pop("BENCH_SKIP_PROBE", None)
+    env.pop("HANDEL_TPU_BENCH_FORCE_PROBE_FAIL", None)
+    env["HANDEL_TPU_PROBE_CACHE"] = str(cache)
+    env["HANDEL_TPU_BENCH_ARTIFACT"] = str(artifact)
+    env["HANDEL_TPU_BENCH_FP_ARTIFACT"] = str(tmp_path / "fp.json")
+    # ladder bait: were the cache ignored, the budget still bounds the run,
+    # but the assertions below would see retry chatter / a probe attempt
+    env["HANDEL_TPU_PROBE_BUDGET_S"] = "30"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "host cache says unreachable" in r.stderr
+    assert "retrying in" not in r.stderr  # no ladder
+    line = json.loads(r.stdout.strip().splitlines()[-1])
+    assert line["source"] == "persisted"
+    assert line["backend"] == "tpu"
+    assert line["value"] == 101.3
+
+
 def test_bench_check_dedupes_persisted_reemits():
     cap = "2026-01-01T00:00:00Z"
     recs = [

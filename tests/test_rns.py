@@ -1,0 +1,145 @@
+"""Fast-tier RNS backend unit checks (ops/rns.py + the ops/fp.py seam).
+
+Compile-cheap by design — the heavy property suites (full parametrized
+mul/inv/pow round-trips, pairing-line boundary chains) are slow-tier in
+tests/test_fp_jax.py; this file keeps tier-1 coverage of the backend seam,
+the basis construction invariants, the float-assisted exact reduction, and
+one small-batch bit-exactness pass so a broken RNS kernel cannot reach CI's
+slow tier unnoticed. scripts/rns_smoke.py wraps the same surface for the
+CI gate.
+"""
+
+import os
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from handel_tpu.ops import bn254_ref as bn
+from handel_tpu.ops.fp import Field
+from handel_tpu.ops.rns import RnsField
+
+
+@pytest.fixture(scope="module")
+def F():
+    return Field(bn.P, backend="rns")
+
+
+def test_backend_seam():
+    """Field(backend=...) construction contract: "rns" redirects to
+    RnsField, "cios"/None stay Field, junk raises, subclass construction
+    is never hijacked."""
+    assert type(Field(bn.P, backend="rns")) is RnsField
+    assert Field(bn.P, backend="rns").backend == "rns"
+    assert type(Field(bn.P, backend="cios")) is Field
+    assert type(Field(bn.P)) is Field
+    assert Field(bn.P).backend == "cios"
+    with pytest.raises(ValueError):
+        Field(bn.P, backend="mxu")
+    with pytest.raises(ValueError):
+        RnsField(bn.P, backend="cios")
+    # direct subclass construction still works
+    assert RnsField(bn.P).backend == "rns"
+
+
+def test_basis_invariants(F):
+    """Every bound the kernel's int32 exactness argument rests on, asserted
+    on the constructed bases (generic over p — BLS12-381 covered in the
+    slow tier)."""
+    import math
+
+    assert F.M >= 4 * F.p
+    assert F.MB > 2 * (F.kA + 1) * F.p
+    assert F.mr > F.kB + 1
+    ms = F.mA + F.mB + [F.mr]
+    assert len(set(ms)) == len(ms)
+    assert all(m < (1 << 13) for m in ms)
+    assert math.gcd(F.M, F.MB * F.mr) == 1
+    # the Montgomery constant is M, not R — pack/unpack self-consistency
+    assert F.mont_r == F.M % F.p
+    assert F.mont_r2 == F.mont_r * F.mont_r % F.p
+    # full 16n-bit positional range reconstructs exactly (CRT range)
+    assert (1 << (16 * F.nlimbs)) <= F.MB
+
+
+def test_mod_rows_exact(F):
+    """The float-assisted reduction is integer-exact over its whole stated
+    domain edge: v near 2^30 and v near 0, across every modulus in play."""
+    m_np = np.array(F.mA + F.mB + [F.mr], np.int32)
+    minv = (1.0 / m_np.astype(np.float64)).astype(np.float32)
+    rng = np.random.default_rng(5)
+    vs = np.concatenate([
+        rng.integers(0, 1 << 30, (64,)),
+        (1 << 30) - 1 - np.arange(8),
+        np.arange(8),
+    ]).astype(np.int32)
+    for i, m in enumerate(m_np):
+        got = np.asarray(
+            F._mod_rows(jnp.asarray(vs), jnp.int32(int(m)),
+                        jnp.float32(float(minv[i])))
+        )
+        assert np.array_equal(got, vs % m), f"inexact mod {m}"
+
+
+def test_small_batch_bit_exact(F):
+    """One jitted RNS mul at batch 8: canonical boundary values bitwise
+    equal to the CIOS kernel's (the backend bit-exactness contract)."""
+    Fc = Field(bn.P, use_pallas=False)
+    rng = np.random.default_rng(17)
+    xs = [int.from_bytes(rng.bytes(32), "little") % bn.P for _ in range(6)]
+    xs += [0, bn.P - 1]
+    ys = list(reversed(xs))
+    got = F.unpack(jax.jit(F.mul)(F.pack(xs), F.pack(ys)))
+    assert got == [x * y % bn.P for x, y in zip(xs, ys)]
+    plain_r = F.pack(xs, mont=False)
+    plain_c = Fc.pack(xs, mont=False)
+    assert np.array_equal(np.asarray(plain_r), np.asarray(plain_c))
+    out_r = F.from_mont(F.mul(F.to_mont(plain_r), F.to_mont(plain_r)))
+    out_c = Fc.from_mont(Fc.mul(Fc.to_mont(plain_c), Fc.to_mont(plain_c)))
+    assert np.array_equal(np.asarray(out_r), np.asarray(out_c))
+
+
+def test_int8_plane_lowering_bit_identical(F):
+    """The int8-planes MXU lowering of the constant contractions is
+    bit-identical to the int32 single-dot lowering."""
+    rng = np.random.default_rng(23)
+    xs = [int.from_bytes(rng.bytes(32), "little") % bn.P for _ in range(8)]
+    a, b = F.pack(xs), F.pack(list(reversed(xs)))
+    base = np.asarray(F.mul(a, b))
+    flipped = F.int8_dots
+    try:
+        F.int8_dots = not flipped
+        assert np.array_equal(np.asarray(F.mul(a, b)), base)
+    finally:
+        F.int8_dots = flipped
+
+
+def test_config_plumbing_to_field():
+    """TOML fp_backend -> SimConfig -> scheme kwargs -> Curves -> Field:
+    the end-to-end selector path, without any device warmup."""
+    from handel_tpu.models.registry import new_scheme
+    from handel_tpu.sim.config import dump_config, load_config
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "cfg.toml")
+        with open(path, "w") as f:
+            f.write('scheme = "bn254-jax"\nfp_backend = "rns"\n')
+        cfg = load_config(path)
+        assert cfg.fp_backend == "rns"
+        assert 'fp_backend = "rns"' in dump_config(cfg)
+        bad = os.path.join(d, "bad.toml")
+        with open(bad, "w") as f:
+            f.write('fp_backend = "vpu"\n')
+        with pytest.raises(ValueError):
+            load_config(bad)
+    sch = new_scheme(
+        "bn254-jax", batch_size=4, mesh_devices=1, fp_backend="rns",
+        warmup=False,
+    )
+    assert sch.constructor.curves.F.backend == "rns"
+    assert type(sch.constructor.curves.F) is RnsField
+    # default stays the CIOS oracle
+    sch_c = new_scheme("bn254-jax", batch_size=4, warmup=False)
+    assert sch_c.constructor.curves.F.backend == "cios"
