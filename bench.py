@@ -528,6 +528,134 @@ def fleet_bench(
     }
 
 
+def small_batch_bench(
+    devices: int = 8,
+    rounds: int = 20,
+    batch: int = 64,
+    per_candidate_ms: float = 1.0,
+    timeout_s: float = 60.0,
+) -> dict:
+    """Mesh latency plane: p50 verify latency of SMALL gold-tier launches
+    riding the whole-mesh lane (parallel/mesh_plane.py) vs an identical-code
+    single-device mesh lane. Where fleet_bench floods the throughput path
+    with distinct aggregates, this bench issues one small launch group at a
+    time — the regime where K per-chip lanes can't help (one launch lands
+    on one chip) but one K-device mesh launch cuts the wall ~K/2x. The
+    engine is HostMeshDevice: real verdict math + real threads, simulated
+    per-candidate wall (per_candidate_ms each, sharded over `devices`
+    workers, plus a serial collective share) — the measured quantity is the
+    dual-mode routing plus genuine intra-launch parallelism, thread
+    contention and Amdahl included. Both runs go through the full service
+    latency path (gold tier -> ModePolicy -> pick_mesh), so the speedup is
+    the contract the MULTICHIP smoke gates: > 1x, approaching K/2 at
+    batch <= 64.
+    """
+    import asyncio
+    import concurrent.futures
+
+    import numpy as np
+
+    from handel_tpu.core.bitset import BitSet
+    from handel_tpu.core.test_harness import FakeScheme
+    from handel_tpu.models.fake import FakePublic, FakeSignature
+    from handel_tpu.parallel.batch_verifier import BatchVerifierService
+    from handel_tpu.parallel.mesh_plane import (
+        ModePolicy,
+        enable_latency_plane,
+        host_mesh_engine,
+    )
+    from handel_tpu.parallel.plane import host_plane
+
+    # registry as wide as the batch so every candidate in a round is a
+    # DISTINCT bitset — the dedup layer must not shrink the launch group
+    # under the bench's feet
+    n_keys = max(16, batch)
+    pks = [FakePublic(True) for _ in range(n_keys)]
+
+    async def run(k: int) -> tuple[float, dict]:
+        loop = asyncio.get_running_loop()
+        loop.set_default_executor(
+            concurrent.futures.ThreadPoolExecutor(max_workers=2 * k + 4)
+        )
+        # one throughput lane (never picked here — every group is small +
+        # gold) plus the mesh lane under test; k=1 is the baseline with
+        # the exact same code path
+        plane = host_plane(FakeScheme().constructor, 1, batch_size=64)
+        svc = BatchVerifierService(plane, max_delay_ms=0.2)
+        enable_latency_plane(
+            svc,
+            host_mesh_engine(
+                FakeScheme().constructor,
+                devices=k,
+                batch_size=64,
+                per_candidate_ms=per_candidate_ms,
+            ),
+            policy=ModePolicy(small_batch_max=64, latency_tiers=("gold",)),
+        )
+        svc.queue.set_tier("gold0", "gold")
+        walls = []
+        try:
+            for r in range(rounds):
+                msg = r.to_bytes(4, "big")
+                reqs = []
+                for i in range(batch):
+                    bs = BitSet(n_keys)
+                    bs.set(i % n_keys, True)
+                    reqs.append((bs, FakeSignature(True)))
+                t0 = time.perf_counter()
+                verdicts = await asyncio.wait_for(
+                    asyncio.gather(
+                        *(
+                            svc.verify(msg, pks, [q], session="gold0")
+                            for q in reqs
+                        )
+                    ),
+                    timeout_s,
+                )
+                walls.append((time.perf_counter() - t0) * 1000.0)
+                if not all(v == [True] for v in verdicts):
+                    raise RuntimeError("small-batch bench verdict mismatch")
+            return float(np.percentile(walls, 50)), svc.values()
+        finally:
+            svc.stop()
+
+    mesh_p50, mesh_vals = asyncio.run(run(devices))
+    base_p50, base_vals = asyncio.run(run(1))
+    if mesh_vals["modeLatencyLaunches"] < rounds:
+        raise RuntimeError(
+            "small-batch bench groups leaked off the latency path: "
+            f"{mesh_vals['modeLatencyLaunches']:.0f}/{rounds} rode the mesh"
+        )
+    return {
+        "small_batch_verify_p50_ms": round(mesh_p50, 3),
+        "small_batch_baseline_p50_ms": round(base_p50, 3),
+        "small_batch_speedup_x": round(base_p50 / mesh_p50, 2)
+        if mesh_p50 > 0
+        else None,
+        "small_batch_mesh_devices": devices,
+        "small_batch_n": batch,
+        "small_batch_latency_launches": int(
+            mesh_vals["modeLatencyLaunches"]
+        ),
+        "small_batch_mesh_fallbacks": int(mesh_vals["meshFallbacks"]),
+    }
+
+
+def _small_batch_metrics() -> dict:
+    """small_batch_bench behind the degrade-don't-die contract (+ a shape
+    override for tests: HANDEL_TPU_BENCH_SMALL_BATCH_SHAPE =
+    'devices,rounds,batch')."""
+    shape = os.environ.get("HANDEL_TPU_BENCH_SMALL_BATCH_SHAPE")
+    try:
+        if shape:
+            devices, rounds, batch = (int(x) for x in shape.split(","))
+            return small_batch_bench(devices, rounds, batch)
+        return small_batch_bench()
+    except Exception as e:
+        print(f"bench: small-batch bench failed: {e}", file=sys.stderr)
+        return {}
+
+
 def swarm_bench(
     identities: int = 512,
     batch_size: int = 64,
@@ -990,6 +1118,8 @@ def _measure() -> None:
         line.update(_service_metrics())
         # fleet plane: K-lane DevicePlane scheduler throughput vs 1 lane
         line.update(_fleet_metrics())
+        # latency plane: small gold-tier launches over the whole-mesh lane
+        line.update(_small_batch_metrics())
         # vnode swarm: identities carried + bytes/identity + completion wall
         line.update(_swarm_metrics())
 
@@ -1057,6 +1187,7 @@ def _measure() -> None:
         line.update(_host_metrics())
         line.update(_service_metrics())
         line.update(_fleet_metrics())
+        line.update(_small_batch_metrics())
         line.update(_swarm_metrics())
         _emit(line)
 

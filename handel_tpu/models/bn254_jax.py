@@ -167,10 +167,15 @@ class BN254Device:
         self.mesh_devices = mesh_devices
         self.mesh = None
         self._sharded_sum = self._sharded_check = None
+        self.mesh_launches = 0
+        self.mesh_candidates = 0
         if mesh_devices > 1:
             from handel_tpu.parallel.sharding import (
                 commit_registry_sharded,
+                launch_partition_rules,
                 make_mesh,
+                make_shard_fns,
+                match_partition_rules,
                 sharded_masked_sum_g2,
                 sharded_pairing_check,
             )
@@ -190,6 +195,21 @@ class BN254Device:
             # registry) per launch
             self._reg_sharded = commit_registry_sharded(
                 self.mesh, self._reg_x, self._reg_y, self.n
+            )
+            # per-launch operand placement by partition rule (the
+            # SNIPPETS.md [1][2] rule-matching/shard_fns idiom): the dense
+            # candidate mask is pre-padded on the host and device_put in
+            # its registry-axis shard_map layout, so `_sharded_sum` sees
+            # one shard per chip instead of re-sharding a replicated mask
+            # every launch (the same win commit_registry_sharded bought
+            # the registry banks)
+            self._mesh_pad = (-self.n) % mesh_devices
+            self._mesh_put = make_shard_fns(
+                self.mesh,
+                match_partition_rules(
+                    launch_partition_rules(),
+                    ["reg_x", "reg_y", "mask", "sig_x", "sig_y", "valid"],
+                ),
             )
             self._affine_kernel = jax.jit(self.curves.g2.to_affine)
             self._neg_kernel = jax.jit(self.curves.F.neg)
@@ -1026,6 +1046,11 @@ class BN254Device:
         # Handel candidates are partitioner ID ranges with few holes: the
         # prefix-table fast path; the dense kernel is the arbitrary-set
         # fallback (plan.kind decides, same classes as always)
+        if self.mesh is not None:
+            # whole-mesh (latency-plane) launch accounting: the mesh lane's
+            # telemetry row (parallel/telemetry.py) reads these
+            self.mesh_launches += 1
+            self.mesh_candidates += int(np.count_nonzero(plan.valid))
         if plan.kind == "range":
             lo, hi, miss_idx, miss_ok, sig_x, sig_y, valid = staged
             if self.mesh is not None:
@@ -1052,11 +1077,18 @@ class BN254Device:
                 .view(np.bool_)
                 .T.copy()
             )
+            # pre-pad to the device multiple (padded rows False — the rule
+            # sharded_masked_sum_g2 applies internally) and place by
+            # partition rule, one registry-axis shard per chip, so the
+            # shard_map region never re-shards a replicated mask
+            if self._mesh_pad:
+                mask = np.pad(mask, ((0, self._mesh_pad), (0, 0)))
+            mask = self._mesh_put["mask"](mask)
             # registry operands are the PRE-PADDED mesh-resident shards
             # committed at construction (one per chip); only the per-launch
             # mask crosses the host boundary here
             (rx0, rx1), (ry0, ry1) = self._reg_sharded
-            agg = self._sharded_sum(rx0, rx1, ry0, ry1, jnp.asarray(mask))
+            agg = self._sharded_sum(rx0, rx1, ry0, ry1, mask)
             return self._sharded_tail(agg, sig_x, sig_y, h_x, h_y, valid)
         return self._kernel(
             self._reg_x,

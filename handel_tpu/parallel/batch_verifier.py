@@ -42,6 +42,7 @@ from handel_tpu.core.bitset import BitSet
 from handel_tpu.core.logging import DEFAULT_LOGGER, Logger
 from handel_tpu.core.store import VerifiedAggCache
 from handel_tpu.core.trace import SERVICE_TID, trace_now
+from handel_tpu.parallel.mesh_plane import MODE_LATENCY, ModePolicy
 from handel_tpu.parallel.plane import BREAKER_CODE, DeviceLane, DevicePlane
 from handel_tpu.service.fairness import TenantQueue
 from handel_tpu.utils.breaker import CircuitBreaker
@@ -100,6 +101,7 @@ class BatchVerifierService:
         quantum: int = 8,
         max_pending_per_session: int = 4096,
         queue_capacity: int = 0,
+        mode_policy: ModePolicy | None = None,
     ):
         if isinstance(device, DevicePlane):
             self.plane = device
@@ -186,6 +188,15 @@ class BatchVerifierService:
         self.fill_launches = 0
         self.last_fill = 0.0
         self.coalesced_launches = 0  # launches mixing >1 distinct message
+        # dual-mode scheduling (parallel/mesh_plane.py): consulted the
+        # moment the plane carries a mesh lane. Counters split the launch
+        # groups by the mode that actually dispatched them; a latency-
+        # eligible group that found the mesh busy (or broken) falls back
+        # to the throughput path and counts a mesh fallback.
+        self.mode_policy = mode_policy or ModePolicy()
+        self.latency_launches = 0
+        self.throughput_launches = 0
+        self.mesh_fallbacks = 0
         # per-tenant counters (service plane labels)
         self.tenant_candidates: dict[str, int] = {}
         self.tenant_dedup_hits: dict[str, int] = {}
@@ -445,17 +456,24 @@ class BatchVerifierService:
             )
         return stall
 
-    def attach_lane(self, engine, breaker: CircuitBreaker | None = None) -> DeviceLane:
+    def attach_lane(self, engine, breaker: CircuitBreaker | None = None,
+                    mesh: bool = False) -> DeviceLane:
         """Grow the verify plane by one lane, live (LaneAutoscaler scale-up
         or breaker-open replacement). When the service is running, the
         lane's dispatcher/fetcher pair spawns immediately and the scheduler
-        can route to it from the next pick."""
-        lane = self.plane.add_lane(engine, breaker)
+        can route to it from the next pick. `mesh=True` attaches a
+        latency-plane mesh lane (parallel/mesh_plane.py enable_latency_
+        plane): only latency-mode groups are routed to it."""
+        lane = self.plane.add_lane(engine, breaker, mesh=mesh)
         if self.rec is not None:
-            self.rec.name_thread(lane.trace_tid, f"device-lane-{lane.index}")
+            kind = "device-mesh" if mesh else "device-lane"
+            self.rec.name_thread(lane.trace_tid, f"{kind}-{lane.index}")
             self.rec.instant(
                 "lane_attached", tid=SERVICE_TID, cat="lifecycle",
-                args={"lane": lane.index, "lanes": len(self.plane)},
+                args={
+                    "lane": lane.index, "lanes": len(self.plane),
+                    "mesh": mesh,
+                },
             )
         if self._task is not None:
             self._wire_lane(asyncio.get_running_loop(), lane)
@@ -574,14 +592,50 @@ class BatchVerifierService:
             [(it[_BITSET], it[_SIG]) for it in items],
         )
 
+    def _group_tier(self, items):
+        """The best SLO tier riding one launch group — highest DRR weight,
+        ties broken by the tighter p99 target. A mixed gold/bronze group
+        routes by its gold passenger: the urgent work defines the group's
+        latency entitlement."""
+        tiers = {self.queue.tier_of(it[_SESSION]) for it in items}
+        return max(tiers, key=lambda t: (t.weight, -t.p99_target_s))
+
+    def _route_mesh(self, items) -> DeviceLane | None:
+        """Dual-mode scheduling (parallel/mesh_plane.py): pick this launch
+        group's mode from its size, the backlog left in the tenant queue,
+        and its best SLO tier; return a free mesh lane for latency-mode
+        groups. None = throughput path — either the policy said so, the
+        plane has no mesh lane, or the mesh is busy/broken (counted as a
+        mesh fallback; breaker-open mesh lanes degrade latency mode to
+        throughput, never to failover)."""
+        mesh = self.plane.mesh_lanes()
+        if not mesh:
+            return None
+        mesh_batch = min(l.engine.batch_size for l in mesh)
+        mode = self.mode_policy.pick_mode(
+            len(items), len(self.queue), self._group_tier(items), mesh_batch
+        )
+        if mode != MODE_LATENCY:
+            self.throughput_launches += 1
+            return None
+        lane = self.plane.pick_mesh()
+        if lane is None:
+            self.mesh_fallbacks += 1
+            self.throughput_launches += 1
+            return None
+        self.latency_launches += 1
+        return lane
+
     async def _acquire_lane(self) -> DeviceLane | None:
-        """Reserve the least-loaded free lane, waiting for one to free up
-        when every admissible lane is occupied. None means every lane's
-        breaker is open — the caller routes the group to failover (the
-        single-chip breaker-open behavior, fleet-wide)."""
+        """Reserve the least-loaded free THROUGHPUT lane, waiting for one
+        to free up when every admissible lane is occupied. None means every
+        throughput lane's breaker is open — the caller routes the group to
+        failover (the single-chip breaker-open behavior, fleet-wide; a
+        healthy mesh lane does not keep bulk groups alive, they don't fit
+        its launch shape)."""
         while True:
             lane = self.plane.pick()
-            if lane is not None or not self.plane.allowed():
+            if lane is not None or not self.plane.throughput_pool():
                 return lane
             self._free.clear()
             await self._free.wait()
@@ -616,9 +670,15 @@ class BatchVerifierService:
             # cancelled mid-hand-off
             self._collector_held = batch
             for i, items in enumerate(self._plan_launches(batch)):
-                if i:
-                    lane = await self._acquire_lane()
-                if lane is None:
+                # dual-mode routing: a latency-mode group takes the mesh
+                # lane (no wait — _route_mesh only returns a FREE one);
+                # everything else rides the reserved throughput lane
+                target = self._route_mesh(items)
+                if target is None:
+                    if i:
+                        lane = await self._acquire_lane()
+                    target = lane
+                if target is None:
                     # every breaker open: host failover (or fail the
                     # futures when no fallback exists)
                     await self._failover(items)
@@ -628,19 +688,22 @@ class BatchVerifierService:
                 # item is the same list object, so a drain double-fail is a
                 # no-op). No await between pick and put -> put_nowait is
                 # safe on the capacity-1 cell.
-                lane.dispatching = items
+                target.dispatching = items
                 if self.rec is not None and self.rec.enabled:
                     # launch_queued span start (the dispatcher reads it when
                     # it takes the group off the capacity-1 cell)
-                    lane.queued_ts = trace_now()
-                lane.q.put_nowait(items)
+                    target.queued_ts = trace_now()
+                target.q.put_nowait(items)
             self._collector_held = None
 
     def _lane_span_args(self, lane: DeviceLane, items: list) -> dict:
         """Launch-lifecycle span args: lane, group size, and the sessions
         whose candidates ride this launch (computed only while tracing —
         the set build never runs on the untraced hot path)."""
-        args = {"lane": lane.index, "n": len(items)}
+        args = {
+            "lane": lane.index, "n": len(items),
+            "mode": "mesh" if lane.mesh else "lane",
+        }
         sessions = sorted({it[_SESSION] for it in items if it[_SESSION]})
         if sessions:
             args["sessions"] = ",".join(sessions)
@@ -702,10 +765,11 @@ class BatchVerifierService:
                 # group fails over; FUTURE groups go to other lanes
                 await self._failover(items)
             else:
-                # launch fill: occupied lanes over lane capacity, recorded
-                # per dispatched launch (the coalescing win metric), on
+                # launch fill: occupied lanes over THIS lane's capacity
+                # (a mesh lane's small-batch engine fills differently from
+                # the throughput lanes), recorded per dispatched launch on
                 # both the service aggregate and the device-labeled row
-                fill = len(items) / self.device.batch_size
+                fill = len(items) / lane.engine.batch_size
                 self.last_fill = fill
                 self.fill_sum += fill
                 self.fill_launches += 1
@@ -845,9 +909,12 @@ class BatchVerifierService:
                 )
                 largs = self._lane_span_args(lane, items)
                 # lane-timeline remainder of the lifecycle: in flight on
-                # the chip since dispatch, and the verdict transfer window
+                # the chip since dispatch, and the verdict transfer window.
+                # Mesh launches carry their own span name so the critical-
+                # path analyzer (sim/trace_cli.py) attributes whole-mesh
+                # walls distinctly from per-chip lane walls.
                 self.rec.span(
-                    "launch_on_device",
+                    "launch_on_mesh" if lane.mesh else "launch_on_device",
                     t_disp,
                     t_end,
                     tid=lane.trace_tid,
@@ -946,6 +1013,12 @@ class BatchVerifierService:
             "deviceRetryCt": float(self.device_retries),
             "failoverBatches": float(self.failover_batches),
             "failoverCandidates": float(self.failover_candidates),
+            # dual-mode scheduling plane (parallel/mesh_plane.py): launch
+            # groups by dispatched mode + latency-eligible groups that
+            # found the mesh busy/broken and fell back to a lane
+            "modeLatencyLaunches": float(self.latency_launches),
+            "modeThroughputLaunches": float(self.throughput_launches),
+            "meshFallbacks": float(self.mesh_fallbacks),
             # lifecycle plane: validator-set epoch + quiesce accounting
             "epoch": float(self.epoch),
             "quiesceCt": float(self.quiesce_ct),
@@ -969,6 +1042,8 @@ class BatchVerifierService:
             "hostDispatchMsPerLaunch",
             "devicesTotal",
             "devicesAvailable",
+            "meshLanes",
+            "meshLanesAvailable",
             "epoch",
             "lastQuiesceStallMs",
             "shedRate",

@@ -50,13 +50,18 @@ class DeviceLane:
     __slots__ = (
         "index", "engine", "breaker", "q", "fetch_q", "dispatching",
         "fetching", "launches", "candidates", "fill_sum", "last_fill",
-        "retries", "fetched", "queued_ts", "draining", "tasks",
+        "retries", "fetched", "queued_ts", "draining", "tasks", "mesh",
     )
 
-    def __init__(self, index: int, engine, breaker: CircuitBreaker | None = None):
+    def __init__(self, index: int, engine, breaker: CircuitBreaker | None = None,
+                 mesh: bool = False):
         self.index = index
         self.engine = engine
         self.breaker = breaker or CircuitBreaker()
+        # latency plane (parallel/mesh_plane.py): a mesh lane's engine
+        # spans the WHOLE device mesh for one launch. pick() skips it —
+        # only latency-mode groups routed via pick_mesh() land here.
+        self.mesh = mesh
         self.q: asyncio.Queue | None = None
         self.fetch_q: asyncio.Queue | None = None
         self.dispatching: list | None = None
@@ -103,6 +108,9 @@ class DeviceLane:
     def values(self) -> dict[str, float]:
         """One `device`-labeled metrics row."""
         return {
+            # scheduling mode of this row: 1 = whole-mesh latency lane,
+            # 0 = per-chip throughput lane (`sim watch` mode column)
+            "mode": 1.0 if self.mesh else 0.0,
             "launches": float(self.launches),
             "candidates": float(self.candidates),
             "fillRatio": (
@@ -148,19 +156,29 @@ class DevicePlane:
         self._next_index = len(self.lanes)
         self.lanes_added = 0
         self.lanes_removed = 0
+        # dual-mode scheduling audit (parallel/mesh_plane.py): latency-mode
+        # picks taken off the mesh lane(s)
+        self.mesh_picks = 0
 
     def __len__(self) -> int:
         return len(self.lanes)
 
     @property
     def batch_size(self) -> int:
+        # the THROUGHPUT batch width: a mesh lane's engine is typically a
+        # small-batch shape and must not set the collector's drain size
+        for l in self.lanes:
+            if not l.mesh:
+                return l.engine.batch_size
         return self.lanes[0].engine.batch_size
 
-    def add_lane(self, engine, breaker: CircuitBreaker | None = None) -> DeviceLane:
-        """Grow the plane by one lane (verify-plane elasticity). The caller
+    def add_lane(self, engine, breaker: CircuitBreaker | None = None,
+                 mesh: bool = False) -> DeviceLane:
+        """Grow the plane by one lane (verify-plane elasticity, or a
+        latency-plane mesh lane when `mesh=True`). The caller
         (BatchVerifierService.attach_lane) wires the asyncio plumbing; a
         bare plane user just gets a new schedulable lane."""
-        lane = DeviceLane(self._next_index, engine, breaker)
+        lane = DeviceLane(self._next_index, engine, breaker, mesh=mesh)
         self._next_index += 1
         self.lanes.append(lane)
         self.lanes_added += 1
@@ -169,9 +187,16 @@ class DevicePlane:
     def remove_lane(self, lane: DeviceLane) -> None:
         """Retire one lane. The last lane is irremovable — a plane with no
         engine cannot serve, and `batch_size`/`device` aliases would
-        dangle."""
-        if len(self.lanes) <= 1:
+        dangle. Likewise the last THROUGHPUT lane while mesh lanes remain:
+        bulk groups don't fit a small-batch mesh engine, so a mesh-only
+        plane (unless built that way outright) cannot serve them."""
+        others = [l for l in self.lanes if l is not lane]
+        if not others:
             raise ValueError("cannot remove the last lane of a DevicePlane")
+        if not lane.mesh and all(l.mesh for l in others):
+            raise ValueError(
+                "cannot remove the last throughput lane of a DevicePlane"
+            )
         self.lanes.remove(lane)
         self.lanes_removed += 1
 
@@ -180,20 +205,50 @@ class DevicePlane:
         admits nothing — it only finishes what it already carries)."""
         return [l for l in self.lanes if not l.draining and l.breaker.allow()]
 
-    def pick(self) -> DeviceLane | None:
-        """Least-loaded free admissible lane; None when none is free."""
+    def throughput_pool(self) -> list[DeviceLane]:
+        """Admissible lanes a THROUGHPUT pick may return: the non-mesh
+        lanes. A plane built purely of mesh lanes (degenerate, but must not
+        deadlock the collector) falls back to the whole admissible set —
+        there a "bulk" group is whatever fits the mesh engine."""
         allowed = self.allowed()
-        free = [l for l in allowed if l.free()]
+        if any(not l.mesh for l in self.lanes):
+            return [l for l in allowed if not l.mesh]
+        return allowed
+
+    def mesh_lanes(self) -> list[DeviceLane]:
+        return [l for l in self.lanes if l.mesh]
+
+    def pick(self) -> DeviceLane | None:
+        """Least-loaded free admissible THROUGHPUT lane; None when none is
+        free. Mesh lanes are never returned here — only latency-mode
+        groups, routed via `pick_mesh`, may occupy the whole mesh."""
+        pool = self.throughput_pool()
+        free = [l for l in pool if l.free()]
         if not free:
             return None
         lane = min(free, key=lambda l: (l.load(), l.index))
         self.sched_picks += 1
         if (
             lane.load() > 0
-            and any(l.load() == 0 for l in allowed)
-            and any(l.load() >= 2 for l in self.lanes)
+            and any(l.load() == 0 for l in pool)
+            and any(l.load() >= 2 for l in self.lanes if not l.mesh)
         ):
             self.idle_violations += 1
+        return lane
+
+    def pick_mesh(self) -> DeviceLane | None:
+        """Free admissible mesh lane for a latency-mode group (least-loaded
+        when several), or None — the caller falls back to the throughput
+        path and counts a mesh fallback. A mesh lane whose breaker is open
+        simply makes latency mode unavailable; it never fails the group."""
+        free = [
+            l for l in self.mesh_lanes()
+            if not l.draining and l.breaker.allow() and l.free()
+        ]
+        if not free:
+            return None
+        lane = min(free, key=lambda l: (l.load(), l.index))
+        self.mesh_picks += 1
         return lane
 
     def inflight_launches(self) -> int:
@@ -220,6 +275,7 @@ class DevicePlane:
 
     def values(self) -> dict[str, float]:
         """Fleet aggregates (folded into the service's values())."""
+        mesh = self.mesh_lanes()
         return {
             "devicesTotal": float(len(self.lanes)),
             "devicesAvailable": float(len(self.allowed())),
@@ -227,6 +283,14 @@ class DevicePlane:
             "schedIdleViolations": float(self.idle_violations),
             "lanesAdded": float(self.lanes_added),
             "lanesRemoved": float(self.lanes_removed),
+            # latency plane (parallel/mesh_plane.py): mesh lane census +
+            # the launches that actually rode the whole mesh
+            "meshLanes": float(len(mesh)),
+            "meshLanesAvailable": float(sum(
+                1 for l in mesh if not l.draining and l.breaker.allow()
+            )),
+            "meshPicks": float(self.mesh_picks),
+            "meshLaunches": float(sum(l.launches for l in mesh)),
         }
 
     def labeled_values(self) -> dict[str, dict[str, float]]:
@@ -235,7 +299,10 @@ class DevicePlane:
         return {str(l.index): l.values() for l in self.lanes}
 
     def labeled_gauge_keys(self) -> set[str]:
-        return {"fillRatio", "lastFill", "inflight", "load", "breakerState"}
+        return {
+            "fillRatio", "lastFill", "inflight", "load", "breakerState",
+            "mode",
+        }
 
 
 def host_plane(constructor, devices: int, batch_size: int = 64,

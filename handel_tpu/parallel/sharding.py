@@ -17,9 +17,22 @@ Two entry points:
     per-device program directly; running GSPMD's propagation/partitioning
     passes over a pairing-sized graph measured >1 h on a 1-core CPU host,
     vs minutes for the shard_map body.
+
+Latency-plane partition rules (ROADMAP item 2, parallel/mesh_plane.py):
+`launch_partition_rules` + `match_partition_rules` map launch-operand NAMES
+to PartitionSpecs by first-matching regex — the rule-matching idiom of the
+t5x/EasyLM partitioning helpers (SNIPPETS.md [1]) — and `make_shard_fns`
+turns the matched specs into per-operand placement functions
+(SNIPPETS.md [2]'s shard_fns, built on `jax.device_put` + NamedSharding
+rather than pjit for the GSPMD-avoidance reason above). BN254Device's mesh
+path uses them to pre-place per-launch operands in their shard_map layout,
+so the whole-mesh launch pays no per-launch all-to-all re-shard.
 """
 
 from __future__ import annotations
+
+import re
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -55,6 +68,51 @@ def make_mesh(n_devices: int | None = None, axis: str = "dp") -> Mesh:
             f"xla_force_host_platform_device_count"
         )
     return Mesh(np.array(devs[:n]), (axis,))
+
+
+def launch_partition_rules(axis: str = "dp"):
+    """(regex, PartitionSpec) rules for one whole-mesh verify launch's
+    operands, matched by name. The mesh-resident banks (registry
+    coordinates, prefix table) shard their point axis; the per-launch
+    candidate mask shards its registry-major rows with them; everything
+    per-candidate (signatures, H(m), validity, range bounds) stays
+    replicated — `sharded_pairing_check` re-shards candidates itself."""
+    return (
+        (r"^(reg|prefix)", P(None, axis)),
+        (r"^mask$", P(axis, None)),
+        (r"", P()),
+    )
+
+
+def match_partition_rules(rules, names) -> dict:
+    """{name: PartitionSpec} by FIRST matching rule (SNIPPETS.md [1]'s
+    tree-path matcher, flattened to plain operand names — launches pass
+    flat arrays, not a pytree of parameters). Rules are searched, not
+    fullmatched, so one table covers `reg_x`/`reg_x0` spellings; a
+    catch-all `(r"", P())` terminal makes the table total."""
+    out = {}
+    for name in names:
+        for pat, spec in rules:
+            if re.search(pat, name):
+                out[name] = spec
+                break
+        else:
+            raise ValueError(f"no partition rule matches operand {name!r}")
+    return out
+
+
+def make_shard_fns(mesh: Mesh, specs: dict) -> dict:
+    """{name: placement fn} from matched specs: each fn `device_put`s its
+    operand with the spec's NamedSharding so downstream shard_map regions
+    see already-placed shards (SNIPPETS.md [2]'s make_shard_and_gather_fns
+    role; device_put instead of pjit keeps GSPMD away from pairing-sized
+    graphs — module docstring)."""
+    from jax.sharding import NamedSharding
+
+    return {
+        name: partial(jax.device_put, device=NamedSharding(mesh, spec))
+        for name, spec in specs.items()
+    }
 
 
 def sharded_masked_sum_g2(
@@ -138,7 +196,10 @@ def sharded_masked_sum_g2(
             pad_pt = lambda a: jnp.pad(a, ((0, 0), (0, pad_n)), mode="edge")
             reg_x0, reg_x1 = pad_pt(reg_x0), pad_pt(reg_x1)
             reg_y0, reg_y1 = pad_pt(reg_y0), pad_pt(reg_y1)
-        if pad_n:
+        if pad_n and mask.shape[0] == n_registry:
+            # masks arriving pre-padded AND pre-placed in the registry-axis
+            # sharding (launch_partition_rules / make_shard_fns) keep their
+            # shards; unpadded masks pad inside the jit as before
             mask = jnp.pad(mask, ((0, pad_n), (0, 0)))  # padded rows: False
         return fn(reg_x0, reg_x1, reg_y0, reg_y1, mask)
 
@@ -210,7 +271,10 @@ def sharded_pairing_check(
             jnp.concatenate([q[1][1] for q in qs], axis=1),
         )
         lane_mask = jnp.concatenate([mask] * len(ps))
-        return pairing.pairing_check((px, py), (qx, qy), lane_mask, local)
+        ok = pairing.pairing_check((px, py), (qx, qy), lane_mask, local)
+        # a fully-masked candidate products to 1 (vacuously True) — fold
+        # validity in so mask False means verdict False, as documented
+        return ok & mask
 
     fn = shard_map(
         body,

@@ -12,6 +12,8 @@ them live into the metrics registry (core/metrics.py, plane "device"):
     handel_device_dispatch_queue_depth  BatchVerifierService pending lane
     handel_device_inflight_launches     dispatched, verdicts not yet fetched
     handel_device_breaker_state         0 closed / 0.5 half-open / 1 open
+    handel_device_mesh_lanes            latency-plane mesh lanes (+_available)
+    handel_device_mesh_launches         launches that rode the whole mesh
 
 jax is imported lazily and every sample degrades to 0.0 on a missing API —
 a fake-scheme node (which must never import jax) can still register this
@@ -111,6 +113,9 @@ class DeviceTelemetry:
             "breakerState": 0.0,
             "deviceLanes": 0.0,
             "deviceLanesAvailable": 0.0,
+            "meshLanes": 0.0,
+            "meshLanesAvailable": 0.0,
+            "meshLaunches": 0.0,
             "profileCaptures": float(self.profile_captures),
         }
         jax = self._jax()
@@ -152,16 +157,31 @@ class DeviceTelemetry:
             if plane is not None:
                 out["deviceLanes"] = float(len(plane.lanes))
                 out["deviceLanesAvailable"] = float(len(plane.allowed()))
+                # latency plane (parallel/mesh_plane.py): mesh lane census
+                # and whole-mesh launch count; getattr keeps pre-mesh stub
+                # planes scrapeable
+                mesh_lanes = getattr(plane, "mesh_lanes", None)
+                if callable(mesh_lanes):
+                    mesh = mesh_lanes()
+                    out["meshLanes"] = float(len(mesh))
+                    out["meshLanesAvailable"] = float(sum(
+                        1 for l in mesh
+                        if not l.draining and l.breaker.allow()
+                    ))
+                    out["meshLaunches"] = float(
+                        sum(l.launches for l in mesh)
+                    )
             else:
                 out["deviceLanes"] = out["deviceLanesAvailable"] = 1.0
         return out
 
     def gauge_keys(self) -> set[str]:
-        # everything here is point-in-time except the two event counters
+        # everything here is point-in-time except the event/launch counters
         return {
             "liveArrays", "liveArrayBytes", "memBytesInUse",
             "dispatchQueueDepth", "inflightLaunches", "breakerState",
             "deviceLanes", "deviceLanesAvailable",
+            "meshLanes", "meshLanesAvailable",
         }
 
     # -- profiler capture (POST /debug/profile) ------------------------------

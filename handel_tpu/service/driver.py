@@ -114,6 +114,8 @@ class MultiSessionCluster:
         seed_base: int = 0,
         config_tweak=None,
         devices: int = 1,
+        mesh_devices: int = 0,
+        mesh_batch_size: int = 8,
         recorder=None,
     ):
         self.k = sessions
@@ -148,6 +150,23 @@ class MultiSessionCluster:
             queue_capacity=queue_capacity,
             recorder=recorder,
         )
+        if mesh_devices > 0:
+            # latency plane ([service] mesh_devices = K): one whole-mesh
+            # lane beside the per-chip throughput lanes — small gold-tier
+            # launch groups ride it (parallel/mesh_plane.py ModePolicy)
+            from handel_tpu.parallel.mesh_plane import (
+                enable_latency_plane,
+                host_mesh_engine,
+            )
+
+            enable_latency_plane(
+                self.service,
+                host_mesh_engine(
+                    scheme.constructor,
+                    devices=mesh_devices,
+                    batch_size=mesh_batch_size,
+                ),
+            )
         # one shared ring across every session's nodes AND the verify
         # plane: session-tagged spans end to end (core/handel.py _sargs,
         # batch_verifier.py lane lifecycle `sessions` arg)
@@ -299,6 +318,8 @@ async def run_in_process(cfg, *, seed_base: int = 0,
         threshold=p.threshold or None,
         scheme=scheme,
         devices=p.devices,
+        mesh_devices=p.mesh_devices,
+        mesh_batch_size=p.mesh_batch_size,
         batch_size=p.batch_size or cfg.batch_size,
         max_sessions=p.max_sessions or None,
         session_ttl_s=p.session_ttl_s,

@@ -140,6 +140,9 @@ class ServiceParams:
     threshold: int = 0  # 0 -> default percentage of `nodes`
     processes: int = 1  # worker node-processes the sessions shard over
     devices: int = 1  # verifier plane lanes (DevicePlane) per process
+    mesh_devices: int = 0  # whole-mesh latency lane width (parallel/
+    # mesh_plane.py); 0 -> no mesh lane, dual-mode scheduling off
+    mesh_batch_size: int = 8  # the mesh lane's (small) launch width
     max_sessions: int = 0  # live-session admission cap; 0 -> `sessions`
     session_ttl_s: float = 60.0  # running session expiry deadline
     quantum: int = 8  # DRR lane credits per tenant ring visit
@@ -402,6 +405,8 @@ def load_config(path: str) -> SimConfig:
         threshold=int(sv.get("threshold", 0)),
         processes=int(sv.get("processes", 1)),
         devices=int(sv.get("devices", 1)),
+        mesh_devices=int(sv.get("mesh_devices", 0)),
+        mesh_batch_size=int(sv.get("mesh_batch_size", 8)),
         max_sessions=int(sv.get("max_sessions", 0)),
         session_ttl_s=float(sv.get("session_ttl_s", 60.0)),
         quantum=int(sv.get("quantum", 8)),
@@ -554,6 +559,8 @@ def dump_config(cfg: SimConfig) -> str:
             f"threshold = {cfg.service.threshold}",
             f"processes = {cfg.service.processes}",
             f"devices = {cfg.service.devices}",
+            f"mesh_devices = {cfg.service.mesh_devices}",
+            f"mesh_batch_size = {cfg.service.mesh_batch_size}",
             f"max_sessions = {cfg.service.max_sessions}",
             f"session_ttl_s = {cfg.service.session_ttl_s}",
             f"quantum = {cfg.service.quantum}",

@@ -448,11 +448,15 @@ def flow_linkage(events: list[dict]) -> tuple[float, int, int]:
 
 def lane_occupancy(events: list[dict]) -> dict:
     """Per device lane: on-device busy fraction over the lane's active
-    window (union of its launch_on_device spans / first-to-last extent),
-    plus the fleet mean — the timeline form of the plane's fill gauges."""
+    window (union of its launch_on_device / launch_on_mesh spans,
+    first-to-last extent), plus the fleet mean — the timeline form of the
+    plane's fill gauges. Mesh launches keep their own span name (distinct
+    attribution in the span table) but busy a lane like any other."""
     by_lane: dict[tuple, list[tuple[float, float]]] = {}
     for e in events:
-        if e.get("ph") == "X" and e.get("name") == "launch_on_device":
+        if e.get("ph") == "X" and e.get("name") in (
+            "launch_on_device", "launch_on_mesh",
+        ):
             by_lane.setdefault(
                 (e.get("pid", 0), e.get("tid", 0)), []
             ).append((e["ts"], e["ts"] + e.get("dur", 0.0)))
@@ -572,7 +576,7 @@ def stream_report(paths: list[str], top_k: int = 10) -> dict:
                         recv_span_ct[a["span"]] = (
                             recv_span_ct.get(a["span"], 0) + 1
                         )
-            elif name == "launch_on_device":
+            elif name in ("launch_on_device", "launch_on_mesh"):
                 lane_ivs.setdefault((pid, e.get("tid", 0)), []).append(
                     (ts, ts + dur)
                 )

@@ -154,6 +154,9 @@ def aggregate(scrapes: list[dict]) -> dict:
         ("load", "handel_device_verifier_load"),
         ("retries", "handel_device_verifier_retries"),
         ("breaker", "handel_device_verifier_breaker_state"),
+        # dual-mode scheduling (parallel/mesh_plane.py): 1 = whole-mesh
+        # latency lane, 0 = per-chip throughput lane
+        ("mode", "handel_device_verifier_mode"),
     ):
         for labels, v in _samples(fams, name):
             did = labels.get("device")
@@ -210,6 +213,16 @@ def aggregate(scrapes: list[dict]) -> dict:
         "shed_rate": mean("handel_device_verifier_shed_rate"),
         "lanes_added": total("handel_device_verifier_lanes_added"),
         "lanes_removed": total("handel_device_verifier_lanes_removed"),
+        # latency plane / dual-mode scheduling (parallel/mesh_plane.py)
+        "mesh_lanes": total("handel_device_verifier_mesh_lanes"),
+        "mesh_launches": total("handel_device_verifier_mesh_launches"),
+        "mode_latency": total(
+            "handel_device_verifier_mode_latency_launches"
+        ),
+        "mode_throughput": total(
+            "handel_device_verifier_mode_throughput_launches"
+        ),
+        "mesh_fallbacks": total("handel_device_verifier_mesh_fallbacks"),
         # flight-recorder plane (core/trace.py values()): ring fill, drops
         # and the spans/s emit rate — the satellite-1 observability row
         "trace_events": total("handel_trace_trace_events"),
@@ -281,23 +294,45 @@ _BREAKER_NAMES = {0.0: "closed", 0.5: "half", 1.0: "open"}
 
 
 def render_devices(model: dict) -> list[str]:
-    """Per-device row block (fleet-of-chips verifier plane): occupancy,
-    fill and breaker state per plane lane, from the `device` label."""
+    """Per-device row block (fleet-of-chips verifier plane): scheduling
+    mode, occupancy, fill and breaker state per plane lane, from the
+    `device` label. Mesh lanes (latency plane, parallel/mesh_plane.py)
+    render `mesh` in the mode column; their mean fill plus the service's
+    mode-split counters make up the summary line."""
     devices = model.get("devices") or {}
     if not devices:
         return []
-    lines = [f"devices  ({len(devices)} verifier lanes)"]
+    mesh_rows = [r for r in devices.values() if r.get("mode", 0.0) >= 1.0]
+    head = f"devices  ({len(devices)} verifier lanes"
+    if mesh_rows:
+        head += f", {len(mesh_rows)} mesh"
+    lines = [head + ")"]
     for did in sorted(devices, key=lambda d: (len(d), d)):
         row = devices[did]
         fill = row.get("fill")
         breaker = _BREAKER_NAMES.get(row.get("breaker", 0.0), "?")
+        mode = "mesh" if row.get("mode", 0.0) >= 1.0 else "lane"
         lines.append(
-            f"  dev {did:>3} launches {int(row.get('launches', 0)):>6}"
+            f"  dev {did:>3} mode {mode}"
+            f"  launches {int(row.get('launches', 0)):>6}"
             f"  inflight {int(row.get('inflight', 0)):>2}"
             f"  load {int(row.get('load', 0)):>2}"
             f"  fill {('--' if fill is None else f'{fill:.2f}')}"
             f"  retries {int(row.get('retries', 0)):>3}"
             f"  breaker {breaker}"
+        )
+    if mesh_rows:
+        fills = [r["fill"] for r in mesh_rows if r.get("fill") is not None]
+        mesh_fill = sum(fills) / len(fills) if fills else None
+        lat = model.get("mode_latency")
+        thr = model.get("mode_throughput")
+        fb = model.get("mesh_fallbacks")
+        lines.append(
+            f"  mesh     launches {int(model.get('mesh_launches') or 0):>6}"
+            f"  fill {('--' if mesh_fill is None else f'{mesh_fill:.2f}')}"
+            f"  modes latency {int(lat or 0)}"
+            f" / throughput {int(thr or 0)}"
+            f"  fallbacks {int(fb or 0)}"
         )
     return lines
 

@@ -54,6 +54,12 @@ SIDE_METRICS = {
     "launches_per_s": "higher",
     "fleet_speedup_x": "higher",
     "fleet_fill_ratio": "higher",
+    # mesh latency plane (bench.py small_batch_bench / parallel/
+    # mesh_plane.py): p50 wall of a small gold-tier launch riding the
+    # whole-mesh lane, and its speedup over the identical-code 1-device
+    # run (the dual-mode scheduling contract: > 1x, ~K/2 at batch <= 64)
+    "small_batch_verify_p50_ms": "lower",
+    "small_batch_speedup_x": "higher",
     # causal-tracing plane (sim trace --report / scripts/trace_smoke.py):
     # wall time from the critical chain's first send to threshold, the
     # fraction of that wall the chain's spans attribute, cross-process

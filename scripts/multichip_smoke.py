@@ -21,6 +21,22 @@ parallel/batch_verifier.py). Four phases:
    no-idle-while-queued scheduler audit, and survive
    `scripts/bench_check.py --dry-run` over a fresh artifact carrying
    launches_per_s / fleet_speedup_x / fleet_fill_ratio.
+5. Latency plane (parallel/mesh_plane.py), three sub-gates:
+   a. Mesh kernel: ONE `BN254Device(mesh_devices=8)` spanning all 8
+      forced host devices drives a batch-8 launch through BOTH whole-mesh
+      aggregation entries — the range class (`_range_agg_kernel`) and the
+      dense masked-sum class (`_sharded_sum`, via the rule-placed padded
+      mask exactly as `_run_plan` stages it; the registry size is chosen
+      indivisible by 8 so the edge-padded shard boundary is live) — and
+      every aggregate must match the host oracle bit-exactly.
+   b. Mode pick: a dual-mode service (throughput HostDevice lanes + a
+      HostMeshDevice mesh lane) must route a small gold-tier group to the
+      mesh lane and a bulk standard-tier flood to the per-lane path, with
+      verdicts matching the scheme and zero mesh fallbacks.
+   c. Bench gate: bench.py small_batch_bench (8-device mesh lane vs the
+      identical-code 1-device run) must report > 1x speedup (the
+      small_batch_verify_p50_ms contract) and survive
+      `scripts/bench_check.py --dry-run` over a fresh artifact.
 """
 
 import json
@@ -269,11 +285,246 @@ def bench_gate() -> None:
     )
 
 
+def mesh_kernel_smoke() -> None:
+    """Phase 5a: one whole-mesh engine, batch-8 launch, both aggregation
+    classes bit-exact vs the host oracle across the edge-padded registry
+    shard boundary."""
+    from handel_tpu.parallel.mesh_plane import bn254_mesh_engine
+
+    # registry indivisible by the mesh width: 70 % 8 = 6, so the last
+    # registry shard carries 2 padded identity rows — the boundary the
+    # sharding tests call out
+    n_mesh, c = 70, 8
+    rng = random.Random(7)
+    sks = [rng.randrange(1, 1 << 20) for _ in range(n_mesh)]
+    pks = [
+        BN254PublicKey(p)
+        for p in nat.g2_mul_batch([bn.G2_GEN] * n_mesh, sks)
+    ]
+    sig = BN254Signature(bn.G1_GEN)
+    eng = bn254_mesh_engine(pks, DEVICES, batch_size=c)
+    assert eng.mesh is not None and eng._mesh_pad == 2, (
+        f"mesh pad not live: pad={eng._mesh_pad}"
+    )
+    t0 = time.perf_counter()
+
+    def check(plan, agg, reqs, label):
+        x, y, inf = eng.curves.g2.to_affine(agg)
+        xs = eng.curves.T.f2_unpack(x)
+        ys = eng.curves.T.f2_unpack(y)
+        infs = np.asarray(inf)
+        for j, (bs, _) in enumerate(reqs):
+            want = host_agg(pks, bs)
+            got = None if infs[j] else (xs[j], ys[j])
+            assert got == want, (
+                f"mesh {label} candidate {j}: aggregate mismatch"
+            )
+
+    # range class: contiguous signer windows -> _range_agg_kernel over the
+    # mesh-resident prefix table
+    reqs = []
+    for _ in range(c):
+        size = rng.randrange(2, 16)
+        lo = rng.randrange(0, n_mesh - size + 1)
+        bs = BitSet(n_mesh)
+        for i in range(lo, lo + size):
+            bs.set(i, True)
+        reqs.append((bs, sig))
+    plan = eng._pack_requests(reqs)
+    assert plan.kind == "range", plan.kind
+    staged = eng._stage_plan(plan)
+    agg = eng._range_agg_kernel(plan.miss_k)(*staged[:4])
+    check(plan, agg, reqs, "range")
+
+    # dense class: sparse signers across the full hull (> MISS_CAP holes)
+    # -> the rule-placed padded mask into _sharded_sum, exactly the
+    # staging _run_plan performs
+    reqs = []
+    for _ in range(c):
+        bs = BitSet(n_mesh)
+        bs.set(0, True)
+        bs.set(n_mesh - 1, True)
+        for i in rng.sample(range(1, n_mesh - 1), 3):
+            bs.set(i, True)
+        reqs.append((bs, sig))
+    plan = eng._pack_requests(reqs)
+    assert plan.kind == "dense", plan.kind
+    mask = (
+        np.unpackbits(
+            plan.words.view(np.uint8), axis=1, count=n_mesh,
+            bitorder="little",
+        )
+        .view(np.bool_)
+        .T.copy()
+    )
+    mask = np.pad(mask, ((0, eng._mesh_pad), (0, 0)))
+    mask = eng._mesh_put["mask"](mask)
+    (rx0, rx1), (ry0, ry1) = eng._reg_sharded
+    agg = eng._sharded_sum(rx0, rx1, ry0, ry1, mask)
+    check(plan, agg, reqs, "dense")
+    print(
+        f"multichip_smoke: whole-mesh engine over {DEVICES} devices, "
+        f"2x{c} aggregates (range + edge-padded dense) bit-exact vs the "
+        f"host oracle in {time.perf_counter() - t0:.1f}s"
+    )
+
+
+def mode_pick_smoke() -> None:
+    """Phase 5b: gold/small -> mesh lane, bulk -> per-lane, verdicts exact,
+    zero fallbacks."""
+    import asyncio
+    import concurrent.futures
+
+    from handel_tpu.core.test_harness import FakeScheme
+    from handel_tpu.models.fake import FakePublic, FakeSignature
+    from handel_tpu.parallel.batch_verifier import BatchVerifierService
+    from handel_tpu.parallel.mesh_plane import (
+        ModePolicy,
+        enable_latency_plane,
+        host_mesh_engine,
+    )
+    from handel_tpu.parallel.plane import host_plane
+
+    scheme = FakeScheme()
+    pks = [FakePublic(True) for _ in range(16)]
+    # lane batch == mesh batch: the collector plans launch groups at the
+    # throughput batch size, so a smaller lane batch would split the
+    # 8-candidate gold group and the second half would find the mesh busy
+    plane = host_plane(scheme.constructor, 2, batch_size=8, launch_ms=1.0)
+    mesh_eng = host_mesh_engine(
+        scheme.constructor, devices=DEVICES, batch_size=8,
+        per_candidate_ms=0.2,
+    )
+
+    # bulk flood: distinct messages, default (standard) tier, every 8th
+    # signature invalid so the verdict check is live
+    bulk = []
+    for i in range(48):
+        b = BitSet(16)
+        b.set(i % 16, True)
+        bulk.append(
+            (i.to_bytes(4, "big"), (b, FakeSignature(i % 8 != 7)))
+        )
+    want_bulk = [
+        scheme.constructor.batch_verify(msg, pks, [r])[0]
+        for msg, r in bulk
+    ]
+    # small gold group: one message, 8 distinct candidates
+    gold = []
+    for i in range(8):
+        b = BitSet(16)
+        b.set(i, True)
+        gold.append((b, FakeSignature(True)))
+
+    async def go():
+        loop = asyncio.get_running_loop()
+        loop.set_default_executor(
+            concurrent.futures.ThreadPoolExecutor(max_workers=24)
+        )
+        svc = BatchVerifierService(plane, max_delay_ms=0.2)
+        enable_latency_plane(
+            svc, mesh_eng, policy=ModePolicy(small_batch_max=8)
+        )
+        svc.queue.set_tier("gold0", "gold")
+        try:
+            got_gold = await asyncio.gather(
+                *(
+                    svc.verify(b"gold-round", pks, [q], session="gold0")
+                    for q in gold
+                )
+            )
+            got_bulk = await asyncio.gather(
+                *(
+                    svc.verify(msg, pks, [r], session=f"s{i % 4}")
+                    for i, (msg, r) in enumerate(bulk)
+                )
+            )
+            return [v[0] for v in got_gold], [v[0] for v in got_bulk], (
+                svc.values()
+            )
+        finally:
+            svc.stop()
+
+    got_gold, got_bulk, vals = asyncio.run(go())
+    assert all(got_gold), "gold-tier mesh verdicts diverge"
+    assert got_bulk == want_bulk, "bulk verdicts diverge from the scheme"
+    assert vals["meshLanes"] == 1.0 and vals["meshLanesAvailable"] == 1.0
+    assert mesh_eng.mesh_launches >= 1, (
+        "small gold-tier group never rode the mesh lane"
+    )
+    assert vals["modeLatencyLaunches"] >= 1.0, vals
+    assert vals["modeThroughputLaunches"] >= 1.0, (
+        f"bulk flood never took the per-lane path: {vals}"
+    )
+    assert vals["meshFallbacks"] == 0.0, vals
+    per_lane = [l.engine.dispatched for l in plane.lanes if not l.mesh]
+    assert all(n >= 1 for n in per_lane), (
+        f"idle throughput lane under the bulk flood: {per_lane}"
+    )
+    print(
+        f"multichip_smoke: mode pick — "
+        f"{vals['modeLatencyLaunches']:.0f} latency launches "
+        f"({mesh_eng.mesh_candidates} candidates on the mesh), "
+        f"{vals['modeThroughputLaunches']:.0f} throughput launches "
+        f"across lanes {per_lane}, 0 fallbacks"
+    )
+
+
+def latency_bench_gate() -> None:
+    """Phase 5c: small-batch mesh bench > 1x + bench_check dry-run."""
+    from bench import small_batch_bench
+
+    m = small_batch_bench(devices=8, rounds=12)
+    assert m["small_batch_speedup_x"] is not None and (
+        m["small_batch_speedup_x"] > 1.0
+    ), f"latency plane speedup below the gate: {m}"
+    assert m["small_batch_mesh_fallbacks"] == 0, m
+    fresh = {
+        "metric": "small_batch_verify_plane_smoke",
+        "value": m["small_batch_verify_p50_ms"],
+        "unit": "ms",
+        "backend": jax.default_backend(),
+        **m,
+    }
+    with tempfile.NamedTemporaryFile("w", suffix=".json", delete=False) as f:
+        json.dump(fresh, f)
+        path = f.name
+    try:
+        r = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(REPO, "scripts", "bench_check.py"),
+                "--dry-run",
+                "--fresh",
+                path,
+            ],
+            capture_output=True,
+            text=True,
+        )
+        sys.stdout.write(r.stdout)
+        sys.stderr.write(r.stderr)
+        assert r.returncode == 0, "bench_check --dry-run failed"
+        assert "small_batch_verify_p50_ms" in r.stdout, (
+            "bench_check did not consider small_batch_verify_p50_ms"
+        )
+    finally:
+        os.unlink(path)
+    print(
+        f"multichip_smoke: latency bench gated — "
+        f"{m['small_batch_verify_p50_ms']} ms p50 at "
+        f"batch {m['small_batch_n']}, {m['small_batch_speedup_x']}x over "
+        f"the 1-device run"
+    )
+
+
 def main() -> int:
     kernel_fleet_smoke()
     service_fleet_smoke()
     degraded_fleet_smoke()
     bench_gate()
+    mesh_kernel_smoke()
+    mode_pick_smoke()
+    latency_bench_gate()
     return 0
 
 

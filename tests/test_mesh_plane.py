@@ -1,0 +1,423 @@
+"""Mesh latency plane & dual-mode scheduling (parallel/mesh_plane.py +
+the mesh-lane integration in parallel/plane.py and batch_verifier.py).
+Host-math engines only — no jax, no kernels: the sharded-kernel side of
+the latency plane lives in tests/test_sharding.py and the MULTICHIP
+smoke; here we pin the POLICY (which launch group rides the whole mesh),
+the scheduler integration (pick vs pick_mesh, fallbacks, breaker
+semantics), and the observability surfaces (mode counters, telemetry,
+`sim watch` mode column)."""
+
+import asyncio
+
+import pytest
+
+from handel_tpu.core.bitset import BitSet
+from handel_tpu.core.test_harness import FakeScheme
+from handel_tpu.models.fake import FakePublic, FakeSignature
+from handel_tpu.parallel.batch_verifier import BatchVerifierService
+from handel_tpu.parallel.mesh_plane import (
+    MODE_LATENCY,
+    MODE_THROUGHPUT,
+    HostMeshDevice,
+    ModePolicy,
+    enable_latency_plane,
+    host_mesh_engine,
+)
+from handel_tpu.parallel.plane import DevicePlane
+from handel_tpu.service.fairness import TIERS
+from handel_tpu.utils.breaker import CircuitBreaker
+
+PKS = [FakePublic(True) for _ in range(16)]
+
+
+class _Engine:
+    batch_size = 64
+
+    def __init__(self):
+        self.dispatched = 0
+
+    def dispatch_multi(self, items):
+        self.dispatched += 1
+        return [True] * len(items)
+
+    def fetch(self, handle):
+        return handle
+
+
+def _req(tag: int, ok: bool = True, n: int = 16):
+    bs = BitSet(n)
+    bs.set(tag % n, True)
+    return (bs, FakeSignature(ok))
+
+
+# -- ModePolicy ----------------------------------------------------------
+
+
+def test_mode_policy_routes_by_size_backlog_and_tier():
+    pol = ModePolicy(small_batch_max=64, max_queue_depth=128)
+    gold, bronze = TIERS["gold"], TIERS["bronze"]
+    # small + shallow + gold -> latency
+    assert pol.pick_mode(8, 0, gold, 64) == MODE_LATENCY
+    # too big for the policy cap -> throughput
+    assert pol.pick_mode(65, 0, gold, 128) == MODE_THROUGHPUT
+    # too big for the MESH ENGINE's batch even if under the cap
+    assert pol.pick_mode(16, 0, gold, 8) == MODE_THROUGHPUT
+    # deep backlog -> throughput (K independent lanes beat one fast lane)
+    assert pol.pick_mode(8, 129, gold, 64) == MODE_THROUGHPUT
+    # tier not entitled to the mesh -> throughput
+    assert pol.pick_mode(8, 0, bronze, 64) == MODE_THROUGHPUT
+    assert pol.pick_mode(8, 0, TIERS["standard"], 64) == MODE_THROUGHPUT
+
+
+def test_mode_policy_accepts_tier_names_and_custom_tiers():
+    pol = ModePolicy(latency_tiers=("gold", "silver"))
+    assert pol.pick_mode(4, 0, "silver", 64) == MODE_LATENCY
+    assert pol.pick_mode(4, 0, TIERS["silver"], 64) == MODE_LATENCY
+    assert pol.pick_mode(4, 0, "bronze", 64) == MODE_THROUGHPUT
+
+
+# -- plane scheduling ----------------------------------------------------
+
+
+def test_pick_never_returns_mesh_lane():
+    plane = DevicePlane([_Engine(), _Engine()])
+    mesh_lane = plane.add_lane(_Engine(), mesh=True)
+    for _ in range(8):
+        assert plane.pick() is not mesh_lane
+    assert plane.mesh_lanes() == [mesh_lane]
+    assert plane.values()["meshLanes"] == 1.0
+
+
+def test_pick_mesh_only_returns_free_admissible_mesh_lane():
+    plane = DevicePlane([_Engine()])
+    br = CircuitBreaker(cooldown_s=600.0)
+    mesh_lane = plane.add_lane(_Engine(), breaker=br, mesh=True)
+    assert plane.pick_mesh() is mesh_lane
+    assert plane.mesh_picks == 1
+    # busy mesh lane -> None (the caller falls back to throughput)
+    mesh_lane.dispatching = ["x"]
+    assert plane.pick_mesh() is None
+    mesh_lane.dispatching = None
+    # breaker-open mesh lane -> None, and the census reflects it
+    for _ in range(br.threshold):
+        br.record_failure()
+    assert plane.pick_mesh() is None
+    assert plane.values()["meshLanesAvailable"] == 0.0
+    assert plane.values()["meshLanes"] == 1.0
+
+
+def test_remove_lane_guards_last_throughput_lane():
+    plane = DevicePlane([_Engine()])
+    plane.add_lane(_Engine(), mesh=True)
+    with pytest.raises(ValueError, match="throughput"):
+        plane.remove_lane(plane.lanes[0])
+    # removing the mesh lane instead is fine
+    plane.remove_lane(plane.lanes[1])
+    assert plane.mesh_lanes() == []
+
+
+def test_mesh_only_plane_throughput_pool_falls_back():
+    """A plane built purely of mesh lanes must not deadlock the collector:
+    the throughput pool degrades to the whole admissible set."""
+    plane = DevicePlane([_Engine()])
+    plane.lanes[0].mesh = True
+    assert plane.throughput_pool() == plane.lanes
+    assert plane.pick() is plane.lanes[0]
+
+
+def test_lane_mode_metric_row():
+    plane = DevicePlane([_Engine()])
+    mesh_lane = plane.add_lane(_Engine(), mesh=True)
+    assert plane.lanes[0].values()["mode"] == 0.0
+    assert mesh_lane.values()["mode"] == 1.0
+    assert "mode" in plane.labeled_gauge_keys()
+
+
+def test_plane_batch_size_ignores_mesh_lane():
+    """The collector's drain width must stay the THROUGHPUT batch: a
+    small-batch mesh engine must not shrink it."""
+
+    class _Small(_Engine):
+        batch_size = 8
+
+    plane = DevicePlane([_Engine()])
+    plane.add_lane(_Small(), mesh=True)
+    assert plane.batch_size == 64
+
+
+# -- HostMeshDevice ------------------------------------------------------
+
+
+def test_host_mesh_device_verdicts_and_counters():
+    scheme = FakeScheme()
+    eng = HostMeshDevice(
+        scheme.constructor, batch_size=8, devices=4,
+        per_candidate_ms=0.0, collective_ms=0.0,
+    )
+    items = [
+        (b"m", PKS, *_req(i, ok=(i != 3))) for i in range(6)
+    ]
+    got = eng.fetch(eng.dispatch_multi(items))
+    assert got == [True, True, True, False, True, True]
+    assert eng.mesh_launches == 1 and eng.mesh_candidates == 6
+    # shard merge must preserve item order at every devices count
+    eng1 = HostMeshDevice(
+        scheme.constructor, batch_size=8, devices=1,
+        per_candidate_ms=0.0, collective_ms=0.0,
+    )
+    assert eng1.dispatch_multi(items) == got
+
+
+def test_host_mesh_device_epoch_parity():
+    eng = host_mesh_engine(
+        FakeScheme().constructor, devices=2, per_candidate_ms=0.0,
+        collective_ms=0.0,
+    )
+    with pytest.raises(RuntimeError, match="stage_registry"):
+        eng.activate_staged()
+    assert eng.stage_registry(PKS) == len(PKS)
+    assert eng.registry_stagings == 1
+    assert eng.activate_staged() == 1
+    assert eng.epoch == 1
+
+
+# -- service integration -------------------------------------------------
+
+
+def _mesh_service(
+    mesh_eng,
+    lanes: int = 2,
+    policy: ModePolicy | None = None,
+    mesh_breaker: CircuitBreaker | None = None,
+):
+    plane = DevicePlane([_Engine() for _ in range(lanes)])
+    svc = BatchVerifierService(plane, max_delay_ms=0.1)
+    enable_latency_plane(
+        svc, mesh_eng, policy=policy or ModePolicy(small_batch_max=8),
+        breaker=mesh_breaker,
+    )
+    svc.queue.set_tier("gold0", "gold")
+    return svc, plane
+
+
+def test_gold_small_group_rides_mesh_lane():
+    mesh_eng = HostMeshDevice(
+        FakeScheme().constructor, batch_size=8, devices=4,
+        per_candidate_ms=0.0, collective_ms=0.0,
+    )
+    svc, plane = _mesh_service(mesh_eng)
+
+    async def go():
+        try:
+            out = await asyncio.gather(
+                *(
+                    svc.verify(b"gold", PKS, [_req(i)], session="gold0")
+                    for i in range(8)
+                )
+            )
+            return out, svc.values()
+        finally:
+            svc.stop()
+
+    out, vals = asyncio.run(go())
+    assert all(v == [True] for v in out)
+    assert mesh_eng.mesh_launches >= 1
+    assert vals["modeLatencyLaunches"] >= 1.0
+    assert vals["meshFallbacks"] == 0.0
+    assert vals["meshLaunches"] >= 1.0
+    # the throughput lanes carried nothing
+    assert all(l.engine.dispatched == 0 for l in plane.lanes if not l.mesh)
+
+
+def test_standard_tier_group_stays_on_lanes():
+    mesh_eng = HostMeshDevice(
+        FakeScheme().constructor, batch_size=8, devices=4,
+        per_candidate_ms=0.0, collective_ms=0.0,
+    )
+    svc, plane = _mesh_service(mesh_eng)
+
+    async def go():
+        try:
+            out = await asyncio.gather(
+                *(
+                    svc.verify(b"bulk", PKS, [_req(i)], session="std")
+                    for i in range(8)
+                )
+            )
+            return out, svc.values()
+        finally:
+            svc.stop()
+
+    out, vals = asyncio.run(go())
+    assert all(v == [True] for v in out)
+    assert mesh_eng.mesh_launches == 0
+    assert vals["modeLatencyLaunches"] == 0.0
+    assert vals["modeThroughputLaunches"] >= 1.0
+    assert sum(l.engine.dispatched for l in plane.lanes if not l.mesh) >= 1
+
+
+def test_oversized_gold_group_stays_on_lanes():
+    """Gold entitlement does not override the size gate: a group bigger
+    than the mesh engine's batch rides the throughput path."""
+    mesh_eng = HostMeshDevice(
+        FakeScheme().constructor, batch_size=8, devices=4,
+        per_candidate_ms=0.0, collective_ms=0.0,
+    )
+    svc, plane = _mesh_service(
+        mesh_eng, policy=ModePolicy(small_batch_max=64)
+    )
+
+    async def go():
+        try:
+            out = await asyncio.gather(
+                *(
+                    svc.verify(b"big", PKS, [_req(i)], session="gold0")
+                    for i in range(24)
+                )
+            )
+            return out, svc.values()
+        finally:
+            svc.stop()
+
+    out, vals = asyncio.run(go())
+    assert all(v == [True] for v in out)
+    assert mesh_eng.mesh_launches == 0
+    assert vals["modeThroughputLaunches"] >= 1.0
+
+
+def test_breaker_open_mesh_lane_degrades_to_throughput():
+    """An open mesh breaker makes latency mode unavailable — groups fall
+    back to the lanes (counted), never to failover."""
+    br = CircuitBreaker(cooldown_s=600.0)
+    for _ in range(br.threshold):
+        br.record_failure()
+    mesh_eng = HostMeshDevice(
+        FakeScheme().constructor, batch_size=8, devices=4,
+        per_candidate_ms=0.0, collective_ms=0.0,
+    )
+    svc, plane = _mesh_service(mesh_eng, mesh_breaker=br)
+
+    async def go():
+        try:
+            out = await asyncio.gather(
+                *(
+                    svc.verify(b"gold", PKS, [_req(i)], session="gold0")
+                    for i in range(8)
+                )
+            )
+            return out, svc.values()
+        finally:
+            svc.stop()
+
+    out, vals = asyncio.run(go())
+    assert all(v == [True] for v in out)
+    assert mesh_eng.mesh_launches == 0
+    assert vals["meshFallbacks"] >= 1.0
+    assert vals["meshLanesAvailable"] == 0.0
+    assert vals["failoverBatches"] == 0.0
+    assert sum(l.engine.dispatched for l in plane.lanes if not l.mesh) >= 1
+
+
+def test_service_gauge_keys_and_values_expose_mode_counters():
+    mesh_eng = HostMeshDevice(
+        FakeScheme().constructor, batch_size=8, devices=2,
+        per_candidate_ms=0.0, collective_ms=0.0,
+    )
+    svc, _ = _mesh_service(mesh_eng)
+    try:
+        vals = svc.values()
+        for key in (
+            "modeLatencyLaunches", "modeThroughputLaunches",
+            "meshFallbacks", "meshLanes", "meshLanesAvailable",
+            "meshPicks", "meshLaunches",
+        ):
+            assert key in vals, key
+        assert vals["meshLanes"] == 1.0
+        assert {"meshLanes", "meshLanesAvailable"} <= svc.gauge_keys()
+    finally:
+        svc.stop()
+
+
+def test_device_telemetry_reports_mesh_census():
+    from handel_tpu.parallel.telemetry import DeviceTelemetry
+
+    mesh_eng = HostMeshDevice(
+        FakeScheme().constructor, batch_size=8, devices=2,
+        per_candidate_ms=0.0, collective_ms=0.0,
+    )
+    svc, _ = _mesh_service(mesh_eng)
+    try:
+        tel = DeviceTelemetry(service=svc)
+        vals = tel.values()
+        assert vals["meshLanes"] == 1.0
+        assert vals["meshLanesAvailable"] == 1.0
+        assert {"meshLanes", "meshLanesAvailable"} <= tel.gauge_keys()
+    finally:
+        svc.stop()
+
+
+def test_mesh_knobs_roundtrip_and_cluster_attaches_lane(tmp_path):
+    """[service] mesh_devices/mesh_batch_size flow through load_config and
+    dump_config, and a cluster built with them serves a run with one mesh
+    lane beside the throughput lanes."""
+    from handel_tpu.service.driver import MultiSessionCluster
+    from handel_tpu.sim.config import dump_config, load_config
+
+    p = tmp_path / "sim.toml"
+    p.write_text(
+        "[sim]\nnodes = 8\n\n[service]\nsessions = 2\ndevices = 2\n"
+        "mesh_devices = 4\nmesh_batch_size = 8\n"
+    )
+    cfg = load_config(str(p))
+    assert cfg.service.mesh_devices == 4
+    assert cfg.service.mesh_batch_size == 8
+    dumped = dump_config(cfg)
+    assert "mesh_devices = 4" in dumped and "mesh_batch_size = 8" in dumped
+    # absent keys keep the latency plane off
+    p.write_text("[sim]\nnodes = 8\n\n[service]\nsessions = 1\n")
+    assert load_config(str(p)).service.mesh_devices == 0
+
+    cluster = MultiSessionCluster(
+        2, 8, devices=2, mesh_devices=4, mesh_batch_size=8,
+        tier_cycle=("gold",),
+    )
+    try:
+        plane = cluster.service.plane
+        assert len(plane.mesh_lanes()) == 1
+        assert len(plane.throughput_pool()) == 2
+        assert plane.mesh_lanes()[0].engine.mesh_devices == 4
+        out = asyncio.run(cluster.run(timeout=60.0))
+        assert out["completed"] == 2
+    finally:
+        cluster.stop()
+
+
+def test_watch_renders_mode_column_and_mesh_summary():
+    """sim watch devices block: per-lane mode column plus the mesh summary
+    line fed by the mode counters."""
+    from handel_tpu.sim.watch_cli import aggregate, parse_exposition, render
+
+    text = (
+        'handel_device_verifier_launches{device="0"} 5\n'
+        'handel_device_verifier_mode{device="0"} 0\n'
+        'handel_device_verifier_launches{device="2"} 3\n'
+        'handel_device_verifier_fill_ratio{device="2"} 0.75\n'
+        'handel_device_verifier_mode{device="2"} 1\n'
+        "handel_device_verifier_mesh_lanes 1\n"
+        "handel_device_verifier_mesh_launches 3\n"
+        "handel_device_verifier_mode_latency_launches 3\n"
+        "handel_device_verifier_mode_throughput_launches 5\n"
+        "handel_device_verifier_mesh_fallbacks 1\n"
+    )
+    model = aggregate([parse_exposition(text)])
+    assert model["devices"]["2"]["mode"] == 1.0
+    assert model["mesh_lanes"] == 1.0
+    assert model["mesh_launches"] == 3.0
+    assert model["mode_latency"] == 3.0
+    assert model["mode_throughput"] == 5.0
+    assert model["mesh_fallbacks"] == 1.0
+    out = render(model, ["x"], 1, 1)
+    assert "mode mesh" in out
+    assert "mode lane" in out
+    assert "1 mesh" in out
+    assert "latency 3" in out or "3 latency" in out

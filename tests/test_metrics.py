@@ -700,12 +700,20 @@ def test_sim_metrics_end_to_end(tmp_path):
             if len(eps) >= 2:
                 for addr in eps:
                     got = await asyncio.to_thread(watch_cli.scrape, addr)
-                    if got is not None:
-                        scraped[addr] = got
-                        code, _ = await asyncio.to_thread(
-                            _get, addr, "/readyz"
-                        )
-                        ready_codes[addr] = code
+                    if got is None:
+                        continue
+                    # the server comes up before the node registers its
+                    # reporters — keep re-scraping until this endpoint is
+                    # warm, or the first boot-window scrape freezes a
+                    # 3-family snapshot the assertions below reject
+                    fams = {n for n in got[0] if n.startswith("handel_")}
+                    if len(fams) < 20:
+                        continue
+                    scraped[addr] = got
+                    code, _ = await asyncio.to_thread(
+                        _get, addr, "/readyz"
+                    )
+                    ready_codes[addr] = code
                 if len(scraped) >= 2:
                     break
             await asyncio.sleep(0.2)

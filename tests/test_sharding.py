@@ -155,6 +155,206 @@ def test_commit_registry_sharded_pads_edge_and_places():
         assert len(shards) == N_DEV  # spread over the whole mesh
 
 
+def test_launch_partition_rules_route_operands():
+    """The latency-plane partition table (launch_partition_rules): mesh-
+    resident banks shard the point axis, the per-launch mask shards its
+    registry-major rows, per-candidate operands stay replicated — and the
+    first-match search covers every spelling a launch stages."""
+    from jax.sharding import PartitionSpec as P
+
+    from handel_tpu.parallel.sharding import (
+        launch_partition_rules,
+        match_partition_rules,
+    )
+
+    specs = match_partition_rules(
+        launch_partition_rules(),
+        ["reg_x", "reg_y", "prefix", "mask", "sig_x", "sig_y",
+         "valid", "lo", "hi", "miss_idx"],
+    )
+    for name in ("reg_x", "reg_y", "prefix"):
+        assert specs[name] == P(None, "dp"), name
+    assert specs["mask"] == P("dp", None)
+    for name in ("sig_x", "sig_y", "valid", "lo", "hi", "miss_idx"):
+        assert specs[name] == P(), name
+    # a table without the catch-all terminal must refuse unknown operands
+    with pytest.raises(ValueError, match="no partition rule"):
+        match_partition_rules(((r"^reg", P(None, "dp")),), ["mask"])
+
+
+def test_make_shard_fns_place_by_rule():
+    """make_shard_fns: rule-matched placement fns produce arrays already
+    laid out in the launch sharding — registry split over the point axis,
+    mask over its rows, replicated operands on every device."""
+    from handel_tpu.parallel.sharding import (
+        launch_partition_rules,
+        make_mesh,
+        make_shard_fns,
+        match_partition_rules,
+    )
+
+    mesh = make_mesh(N_DEV)
+    put = make_shard_fns(
+        mesh,
+        match_partition_rules(
+            launch_partition_rules(), ["reg_x", "mask", "sig_x"]
+        ),
+    )
+    reg = put["reg_x"](np.zeros((4, 16), np.uint32))
+    mask = put["mask"](np.zeros((16, 4), bool))
+    sig = put["sig_x"](np.zeros((4, 4), np.uint32))
+    assert len(reg.sharding.device_set) == N_DEV
+    assert reg.sharding.shard_shape(reg.shape) == (4, 2)  # point axis split
+    assert mask.sharding.shard_shape(mask.shape) == (2, 4)  # row axis split
+    assert sig.sharding.is_fully_replicated
+
+
+@pytest.mark.parametrize("k", [1, 2, 8])
+def test_sharded_masked_sum_matches_dense_across_mesh_widths(k):
+    """K ∈ {1, 2, 8}: the registry-sharded masked sum must equal the dense
+    single-device oracle bit-exactly at every mesh width — K=1 is the
+    degenerate whole-mesh-is-one-chip shape, K ∈ {2, 8} leave an
+    edge-padded final shard (11 % 2 == 1, 11 % 8 == 3)."""
+    import jax.numpy as jnp
+
+    from handel_tpu.ops.curve import BN254Curves
+    from handel_tpu.parallel.sharding import make_mesh, sharded_masked_sum_g2
+
+    n_reg, batch = 11, 4
+    curves = BN254Curves()
+    T, g2 = curves.T, curves.g2
+    _, pks = _keys(n_reg, seed=31)
+    reg_x = T.f2_pack([p[0] for p in pks])
+    reg_y = T.f2_pack([p[1] for p in pks])
+    rng = np.random.default_rng(5)
+    mask = rng.random((n_reg, batch)) < 0.5
+    mask[:, 2] = False  # one empty candidate per width
+
+    fn = sharded_masked_sum_g2(curves, make_mesh(k), n_reg, batch)
+    agg = fn(reg_x[0], reg_x[1], reg_y[0], reg_y[1], jnp.asarray(mask))
+
+    tile = lambda a: jnp.repeat(a, batch, axis=1)
+    P2 = g2.from_affine(
+        (tile(reg_x[0]), tile(reg_x[1])), (tile(reg_y[0]), tile(reg_y[1]))
+    )
+    want = g2.masked_sum(P2, jnp.asarray(mask.reshape(-1)), n_reg)
+    got_inf = np.asarray(g2.is_infinity(agg))
+    np.testing.assert_array_equal(
+        got_inf, np.asarray(g2.is_infinity(want))
+    )
+    assert got_inf[2]
+    gx, gy, _ = g2.to_affine(agg)
+    wx, wy, _ = g2.to_affine(want)
+    for g, w in ((gx, wx), (gy, wy)):
+        for c in range(2):
+            np.testing.assert_array_equal(
+                np.asarray(g[c])[:, ~got_inf],
+                np.asarray(w[c])[:, ~got_inf],
+            )
+
+
+def test_sharded_masked_sum_preplaced_padded_mask():
+    """The latency-plane staging path (BN254Device._run_plan dense class):
+    a mask pre-padded to the device multiple and pre-placed by partition
+    rule must keep its shards (the pad-skip branch in
+    sharded_masked_sum_g2) and produce the exact aggregates of the
+    replicated unpadded call."""
+    import jax
+    import jax.numpy as jnp
+
+    from handel_tpu.ops.curve import BN254Curves
+    from handel_tpu.parallel.sharding import (
+        launch_partition_rules,
+        make_mesh,
+        make_shard_fns,
+        match_partition_rules,
+        sharded_masked_sum_g2,
+    )
+
+    n_reg, batch = 20, 8  # pads to 24: the final shard is half padding
+    pad_n = (-n_reg) % N_DEV
+    curves = BN254Curves()
+    T, g2 = curves.T, curves.g2
+    _, pks = _keys(n_reg, seed=37)
+    reg_x = T.f2_pack([p[0] for p in pks])
+    reg_y = T.f2_pack([p[1] for p in pks])
+    rng = np.random.default_rng(11)
+    mask = rng.random((n_reg, batch)) < 0.5
+
+    mesh = make_mesh(N_DEV)
+    fn = sharded_masked_sum_g2(curves, mesh, n_reg, batch)
+    put = make_shard_fns(
+        mesh, match_partition_rules(launch_partition_rules(), ["mask"])
+    )
+    placed = put["mask"](np.pad(mask, ((0, pad_n), (0, 0))))
+    assert placed.sharding.shard_shape(placed.shape) == (
+        (n_reg + pad_n) // N_DEV, batch,
+    )
+    got = fn(reg_x[0], reg_x[1], reg_y[0], reg_y[1], placed)
+    want = fn(reg_x[0], reg_x[1], reg_y[0], reg_y[1], jnp.asarray(mask))
+    for a, b in zip(
+        jax.tree_util.tree_leaves(got), jax.tree_util.tree_leaves(want)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("k", [1, 2, 8])
+def test_sharded_pairing_check_matches_oracle_across_mesh_widths(k):
+    """K ∈ {1, 2, 8}: the candidate-sharded Miller loop + final
+    exponentiation product check must agree with the scalar reference
+    oracle bit-exactly at every mesh width — K=8 pads the 4-candidate
+    batch with masked lanes (4 % 8), K=2 splits it 2/2, K=1 is the dense
+    single-device graph itself."""
+    import jax.numpy as jnp
+
+    from handel_tpu.ops import bn254_ref as bn
+    from handel_tpu.ops.curve import BN254Curves
+    from handel_tpu.ops.pairing import BN254Pairing
+    from handel_tpu.parallel.sharding import make_mesh, sharded_pairing_check
+
+    groups = 4
+    curves = BN254Curves()
+    pr = BN254Pairing(curves)
+    rng = random.Random(41 + k)
+    h = bn.g1_mul(bn.G1_GEN, rng.randrange(1, bn.R))  # H(m)
+    sks = [rng.randrange(1, bn.R) for _ in range(groups)]
+    pks = [bn.g2_mul(bn.G2_GEN, sk) for sk in sks]
+    sigs = [bn.g1_mul(h, sk) for sk in sks]
+    sigs[2] = bn.g1_mul(h, sks[2] + 1)  # candidate 2 forged
+
+    def pack1(pts):
+        return (
+            curves.F.pack([p[0] for p in pts]),
+            curves.F.pack([p[1] for p in pts]),
+        )
+
+    def pack2(pts):
+        return (
+            curves.T.f2_pack([q[0] for q in pts]),
+            curves.T.f2_pack([q[1] for q in pts]),
+        )
+
+    # pair 0: e(H, pk_j); pair 1: e(-sig_j, G2) — BLS verify as one product
+    ps = (pack1([h] * groups), pack1([bn.g1_neg(s) for s in sigs]))
+    qs = (pack2(pks), pack2([bn.G2_GEN] * groups))
+    mask = np.ones((groups,), bool)
+    mask[3] = False  # one masked-out lane: must come back False
+
+    fn = sharded_pairing_check(pr, make_mesh(k), groups)
+    got = [bool(v) for v in np.asarray(fn(ps, qs, jnp.asarray(mask)))]
+
+    # dense single-device oracle: the scalar reference product per candidate
+    want = []
+    for j in range(groups):
+        prod = bn.f12_mul(
+            bn.pairing(pks[j], h),
+            bn.pairing(bn.G2_GEN, bn.g1_neg(sigs[j])),
+        )
+        want.append(bool(mask[j]) and prod == bn.F12_ONE)
+    assert got == want == [True, True, False, False]
+
+
 @pytest.mark.slow
 def test_device_batch_verify_sharded():
     """The wired path: BN254Device(mesh_devices=8).batch_verify — valid
