@@ -48,6 +48,7 @@ class BLS12381JaxConstructor(BLS12381Constructor, BN254JaxConstructor):
         mesh_devices: int = 1,
         warmup: bool = True,
         fp_backend: str | None = None,
+        rns_resident: bool | None = None,
     ):
         BN254JaxConstructor.__init__(
             self,
@@ -56,6 +57,7 @@ class BLS12381JaxConstructor(BLS12381Constructor, BN254JaxConstructor):
             mesh_devices=mesh_devices,
             warmup=warmup,
             fp_backend=fp_backend,
+            rns_resident=rns_resident,
         )
 
 
@@ -69,10 +71,12 @@ class BLS12381JaxScheme(BLS12381Scheme):
         mesh_devices: int = 1,
         warmup: bool = True,
         fp_backend: str | None = None,
+        rns_resident: bool | None = None,
     ):
         self.constructor = BLS12381JaxConstructor(
             batch_size=batch_size,
             mesh_devices=mesh_devices,
             warmup=warmup,
             fp_backend=fp_backend,
+            rns_resident=rns_resident,
         )

@@ -127,9 +127,13 @@ class BN254Device:
         curves: BN254Curves | None = None,
         mesh_devices: int = 1,
         jax_device=None,
+        rns_resident: bool | None = None,
     ):
         self.curves = curves or self.Curves()
-        self.pairing = self.Pairing(self.curves)
+        # rns_resident toggles the residue-resident pairing form
+        # (ops/pairing.py): None = auto (on exactly for the 'rns' field
+        # backend), False forces per-mul CRT, True demands the rns backend
+        self.pairing = self.Pairing(self.curves, resident=rns_resident)
         self.batch_size = batch_size
         self.n = len(registry_pubkeys)
         # fleet pinning (parallel/plane.py): when `jax_device` is given,
@@ -1220,10 +1224,12 @@ class BN254JaxConstructor(BN254Constructor):
         host_fallback: bool = True,
         breaker: CircuitBreaker | None = None,
         fp_backend: str | None = None,
+        rns_resident: bool | None = None,
     ):
         self.batch_size = batch_size
         self.mesh_devices = mesh_devices
         self.fp_backend = fp_backend
+        self.rns_resident = rns_resident
         # fp_backend picks the Field modmul kernel (ops/fp.py backend seam:
         # "cios"/"rns"); an explicit `curves` wins, carrying its own Field
         self.curves = curves or self.Device.Curves(backend=fp_backend)
@@ -1242,6 +1248,7 @@ class BN254JaxConstructor(BN254Constructor):
             batch_size=self.batch_size,
             curves=self.curves,
             mesh_devices=self.mesh_devices,
+            rns_resident=self.rns_resident,
         )
         if self.warmup:
             # compile all reachable kernels NOW, at scheme construction, so
@@ -1318,12 +1325,14 @@ class BN254JaxScheme(BN254Scheme):
         mesh_devices: int = 1,
         warmup: bool = True,
         fp_backend: str | None = None,
+        rns_resident: bool | None = None,
     ):
         self.constructor = BN254JaxConstructor(
             batch_size=batch_size,
             mesh_devices=mesh_devices,
             warmup=warmup,
             fp_backend=fp_backend,
+            rns_resident=rns_resident,
         )
 
 

@@ -62,6 +62,19 @@ bit-identical across backends.
     models/bn254_jax.py -> ops/curve.py -> here. The CIOS kernel stays
     the bit-exact oracle (tests/test_fp_jax.py, scripts/rns_smoke.py).
 
+    On top of the per-mul pipeline the RNS backend exposes a **resident
+    value form** (`RnsField.to_resident`/`mul_resident`/`from_resident`
+    plus the `ResidentRns` Field-shaped adapter): values stay as joint
+    residue planes across whole tower formulas, and the CRT
+    reconstruction that `RnsField.mul` pays at every call is deferred to
+    genuine pairing boundaries (point coordinates entering the Miller
+    loop, the final GT verdict). `ops/pairing.py` threads that form
+    through the Miller loop and the final-exponentiation tower when the
+    backend is RNS (opt out via `rns_resident`); subtraction sites carry
+    static per-site bound literals (`blog`, accepted-and-ignored by the
+    CIOS `sub`/`neg` above) — HACKING.md "Residue-resident pairing" has
+    the bound algebra.
+
 Figure walk-through (results/fp_microbench.json): the artifact's `note`
 reconciles the four CIOS figures (15.5M naive-timing error / 13.1M
 small-batch mxu_lab control / 357M production marginal / 250-436M tunnel
@@ -214,6 +227,10 @@ class Field:
     """
 
     backend = "cios"
+    # dtype of one batch row; ops/tower.py consults this so its zero/one
+    # constructors match the value representation (uint32 positional limbs
+    # here, int32 residue rows for ops/rns.py's ResidentRns adapter)
+    limb_dtype = jnp.uint32
 
     def __new__(cls, p: int = 0, use_pallas: bool | None = None,
                 backend: str | None = None):
@@ -227,7 +244,10 @@ class Field:
                  backend: str | None = None):
         if backend not in (None, "cios", "rns"):
             raise ValueError(
-                f"unknown Field backend {backend!r} (want 'cios' or 'rns')"
+                f"unknown Field backend {backend!r}: valid choices are "
+                f"'cios' (VPU CIOS kernel, the bit-exact oracle) and 'rns' "
+                f"(MXU residue pipeline, ops/rns.py; its residue-resident "
+                f"pairing form is toggled by the `rns_resident` knob)"
             )
         self.p = p
         self.nlimbs = (p.bit_length() + LIMB_BITS - 1) // LIMB_BITS
@@ -462,7 +482,11 @@ class Field:
         r, _ = self._ks_carry(a + b)  # a, b < p so a+b < 2p < 2^256
         return self._cond_sub_p(r)
 
-    def sub(self, a, b):
+    def sub(self, a, b, blog: int | None = None):
+        """a - b mod p. `blog` is the resident-form subtrahend bound knob
+        (ops/rns.py `ResidentRns.sub`): canonical positional limbs are always
+        < p, so the CIOS kernel ignores it — accepting the parameter keeps
+        ops/tower.py's per-site bound literals backend-agnostic."""
         t = a.astype(jnp.int32) - b.astype(jnp.int32)
         bor, borrowed = self._borrow_chain(t)
         raw = ((t - bor) & LIMB_MASK).astype(jnp.uint32)  # a-b mod 2^256
@@ -473,8 +497,8 @@ class Field:
         r, _ = self._ks_carry(raw + padd)
         return r
 
-    def neg(self, a):
-        return self.sub(jnp.zeros_like(a), a)
+    def neg(self, a, blog: int | None = None):
+        return self.sub(jnp.zeros_like(a), a, blog)
 
     def mul(self, a, b):
         """Montgomery product. Pallas kernel on TPU, pure XLA elsewhere."""

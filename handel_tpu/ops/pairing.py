@@ -50,10 +50,29 @@ _ATE_BITS = [int(c) for c in bin(bn.ATE_LOOP_COUNT)[3:]]
 class BN254Pairing:
     """Batched optimal-ate pairing over the shared Field/Tower/Curves stack."""
 
-    def __init__(self, curves: BN254Curves | None = None):
+    def __init__(self, curves: BN254Curves | None = None,
+                 resident: bool | None = None):
         self.curves = curves or self._default_curves()
         self.F: Field = self.curves.F
         self.T: Tower = self.curves.T
+        # Residue-resident mode (rns backend): the Miller loop and final
+        # exponentiation run entirely on joint-residue values — positional
+        # limbs appear only at genuine boundaries (point coordinates in,
+        # GT verdict/element out). None = auto: on exactly when the field
+        # backend is 'rns'.
+        if resident is None:
+            resident = self.F.backend == "rns"
+        elif resident and self.F.backend != "rns":
+            raise ValueError(
+                f"resident pairing needs the 'rns' field backend (got "
+                f"{self.F.backend!r}): construct the curve stack with "
+                f"backend='rns' / fp_backend = \"rns\", or pass "
+                f"resident=False"
+            )
+        self.resident = resident
+        # every internal tower call routes through _Tw; the public entry
+        # points convert at the boundaries when _Tw is the resident tower
+        self._Tw: Tower = self.T.as_resident() if resident else self.T
         # Note on static unrolling: emitting the Miller loop's 64 steps as
         # straight-line code (skipping the ~39 0-bit add branches the scan
         # computes and discards) was measured and REJECTED — the ~60x-larger
@@ -75,10 +94,46 @@ class BN254Pairing:
 
     def _mm(self, pairs):
         """Stack independent Fp2 multiplications into one f2_mul call."""
-        T = self.T
+        T = self._Tw
         lhs = T._f2_stack([p[0] for p in pairs])
         rhs = T._f2_stack([p[1] for p in pairs])
         return T._f2_unstack(T.f2_mul(lhs, rhs), len(pairs))
+
+    def _points_in(self, p, q):
+        """Boundary conversion IN: the six point-coordinate arrays (G1 x, y
+        and the two Fp2 G2 coordinates) residue-convert in ONE stacked
+        to_resident — this plus the verdict/element conversion out is the
+        entire positional surface of a resident pairing. No-op when the
+        pairing runs positionally."""
+        xp, yp = p
+        xq, yq = q
+        if not self.resident:
+            return p, q
+        F = self.F
+        cat = jnp.concatenate([xp, yp, xq[0], xq[1], yq[0], yq[1]], axis=1)
+        b = xp.shape[1]
+        r = F.to_resident(cat)
+        parts = [r[:, i * b : (i + 1) * b] for i in range(6)]
+        return (parts[0], parts[1]), (
+            (parts[2], parts[3]),
+            (parts[4], parts[5]),
+        )
+
+    def _f12_out(self, f):
+        """Boundary conversion OUT: a resident Fp12 element reconstructs to
+        canonical positional limbs in ONE stacked from_resident (12 coords
+        wide). Passthrough when positional."""
+        if not self.resident:
+            return f
+        T, F = self.T, self.F
+        flat = self._Tw._flatten12(f)
+        b = flat[0].shape[1]
+        v = F.from_resident(jnp.concatenate(flat, axis=1))
+        parts = [v[:, i * b : (i + 1) * b] for i in range(12)]
+        return (
+            ((parts[0], parts[1]), (parts[2], parts[3]), (parts[4], parts[5])),
+            ((parts[6], parts[7]), (parts[8], parts[9]), (parts[10], parts[11])),
+        )
 
     @staticmethod
     def _dbl_n(T, a, k: int):
@@ -97,7 +152,7 @@ class BN254Pairing:
         but triples the kernel-launch count — measured slower.)
         """
         c_yp, c_xp, c_const = line
-        z = self.T.f2_zero(batch)
+        z = self._Tw.f2_zero(batch)
         return ((c_yp, z, z), (c_xp, c_const, z))
 
     # -- Miller-loop steps (bn254_ref.miller_loop_projective dbl/add) --------
@@ -105,8 +160,14 @@ class BN254Pairing:
     def _dbl_step(self, Tpt, xp, yp):
         """Doubling step: new T and the tangent line at T evaluated at
         P = (xp, yp). Line scaled by 2YZ^3 (killed by final exp)."""
-        Tw = self.T
+        Tw = self._Tw
         X, Y, Z = Tpt
+        # Resident bound walk (T invariant X <= 2^8*p, Y <= 2^12*p,
+        # Z <= 2^8*p; xp/yp enter at bound 0): every product lands <= 2^8*p
+        # (f2_mul), so the blog literals below are the derived-subtrahend
+        # bounds — the full table is in HACKING.md "Residue-resident
+        # pairing". Output T3 = (<=8, <=12, <=8) re-establishes the
+        # invariant; line coefficients <= 2^10*p.
         XX, YY, YZ = self._mm([(X, X), (Y, Y), (Y, Z)])
         n = Tw.f2_add(Tw.f2_add(XX, XX), XX)  # 3X^2
         d = Tw.f2_add(YZ, YZ)  # 2YZ
@@ -114,7 +175,7 @@ class BN254Pairing:
             [(n, n), (d, d), (YY, Z), (YZ, Z), (n, Z), (n, X)]
         )
         XYYZ, ddd = self._mm([(X, YYZ), (dd, d)])
-        e = Tw.f2_sub(nn, self._dbl_n(Tw, XYYZ, 3))  # n^2 - 8XY^2Z
+        e = Tw.f2_sub(nn, self._dbl_n(Tw, XYYZ, 3), 11)  # n^2 - 8XY^2Z
         # 12*XYYZ = 8*XYYZ + 4*XYYZ by add chains
         XYYZ12 = Tw.f2_add(self._dbl_n(Tw, XYYZ, 3), self._dbl_n(Tw, XYYZ, 2))
         # line coefficients; xp/yp are base-field: embed as (x, 0) Fp2
@@ -122,30 +183,34 @@ class BN254Pairing:
         X3, t, YYZ2, c0, cw = self._mm(
             [
                 (e, d),
-                (n, Tw.f2_sub(XYYZ12, nn)),  # n*(12XY^2Z - n^2)
+                (n, Tw.f2_sub(XYYZ12, nn, 8)),  # n*(12XY^2Z - n^2)
                 (YYZ, YYZ),  # (Y^2 Z)^2 = Y^4 Z^2
                 (YZZ, (yp, zero)),
                 (nZ, (xp, zero)),
             ]
         )
-        Y3 = Tw.f2_sub(t, self._dbl_n(Tw, YYZ2, 3))
+        Y3 = Tw.f2_sub(t, self._dbl_n(Tw, YYZ2, 3), 11)
         T3 = (X3, Y3, ddd)
         line = (
             Tw.f2_add(c0, c0),  # 2YZ^2 * yp
-            Tw.f2_neg(cw),  # -3X^2 Z * xp
-            Tw.f2_sub(nX, Tw.f2_add(YYZ, YYZ)),  # 3X^3 - 2Y^2 Z
+            Tw.f2_neg(cw, 8),  # -3X^2 Z * xp
+            Tw.f2_sub(nX, Tw.f2_add(YYZ, YYZ), 9),  # 3X^3 - 2Y^2 Z
         )
         return T3, line
 
     def _add_step(self, Tpt, Q, xp, yp):
         """Mixed-addition step T + Q (Q affine) and the line through them
         evaluated at P. Line scaled by d = x2 Z - X."""
-        Tw = self.T
+        Tw = self._Tw
         X, Y, Z = Tpt
         x2, y2 = Q
+        # Resident bounds: T at the (8, 12, 8) invariant, Q affine coords
+        # <= 2^9*p (loop Q enters at 0; the psi-correction points of the BN
+        # tail at <= 2^9*p) — n <= 2^13*p, d <= 2^9*p, every mul exponent
+        # sum well under RES_MUL_LOG2; output T3 <= (8, 9, 8).
         y2Z, x2Z = self._mm([(y2, Z), (x2, Z)])
-        n = Tw.f2_sub(y2Z, Y)
-        d = Tw.f2_sub(x2Z, X)
+        n = Tw.f2_sub(y2Z, Y, 12)
+        d = Tw.f2_sub(x2Z, X, 8)
         zero = jnp.zeros_like(xp)
         dd, nn, nx2, dy2, c0, cw = self._mm(
             [(d, d), (n, n), (n, x2), (d, y2), (d, (yp, zero)), (n, (xp, zero))]
@@ -153,12 +218,12 @@ class BN254Pairing:
         nnZ, Xdd, ddd, x2Zdd = self._mm(
             [(nn, Z), (Tw.f2_add(X, x2Z), dd), (dd, d), (x2Z, dd)]
         )
-        e = Tw.f2_sub(nnZ, Xdd)
+        e = Tw.f2_sub(nnZ, Xdd, 8)
         X3, t, y2Zddd, Z3 = self._mm(
-            [(e, d), (n, Tw.f2_sub(x2Zdd, e)), (y2Z, ddd), (Z, ddd)]
+            [(e, d), (n, Tw.f2_sub(x2Zdd, e, 9)), (y2Z, ddd), (Z, ddd)]
         )
-        Y3 = Tw.f2_sub(t, y2Zddd)
-        line = (c0, Tw.f2_neg(cw), Tw.f2_sub(nx2, dy2))
+        Y3 = Tw.f2_sub(t, y2Zddd, 8)
+        line = (c0, Tw.f2_neg(cw, 8), Tw.f2_sub(nx2, dy2, 8))
         return (X3, Y3, Z3), line
 
     # -- Miller loop ---------------------------------------------------------
@@ -172,9 +237,18 @@ class BN254Pairing:
 
         p: (xp, yp) base-field limb arrays (G1 affine), q: ((x...), (y...))
         Fp2 pairs (G2' affine), mask: optional (B,) bool — lanes with mask
-        False (infinity/padding) return f = 1. Output: Fp12 batch.
-        """
-        Tw = self.T
+        False (infinity/padding) return f = 1. Output: Fp12 batch
+        (canonical positional limbs in either mode — resident runs convert
+        at this public boundary)."""
+        return self._f12_out(self._miller_loop_res(p, q, mask))
+
+    def _miller_loop_res(self, p, q, mask=None):
+        """`miller_loop` staying in the working representation (resident
+        joint residues when self.resident) — the form `pairing` and
+        `pairing_check` chain into the final exponentiation without an
+        intermediate CRT reconstruction."""
+        Tw = self._Tw
+        p, q = self._points_in(p, q)
         xp, yp = p
         xq, yq = q
         batch = xp.shape[1]
@@ -202,14 +276,17 @@ class BN254Pairing:
 
     def _miller_tail(self, Tpt, f, q, xp, yp, batch):
         """BN ate corrections: add psi(Q) and -psi^2(Q) on the twist
-        (bn254_ref.miller_loop_projective tail)."""
-        Tw = self.T
+        (bn254_ref.miller_loop_projective tail). Resident: input points are
+        bound-0 (canonical y < p makes the blog=0 conjugate nonnegative);
+        the psi products land <= 2^8*p, so the correction points enter
+        `_add_step` within its <= 2^9*p affine budget."""
+        Tw = self._Tw
         xq, yq = q
         g2 = Tw.f2_constant(self._g2c, batch)
         g3 = Tw.f2_constant(self._g3c, batch)
-        q1x, q1y = self._mm([(Tw.f2_conj(xq), g2), (Tw.f2_conj(yq), g3)])
-        q2x, q2y = self._mm([(Tw.f2_conj(q1x), g2), (Tw.f2_conj(q1y), g3)])
-        q2y = Tw.f2_neg(q2y)  # q2 = -psi^2(Q)
+        q1x, q1y = self._mm([(Tw.f2_conj(xq, 0), g2), (Tw.f2_conj(yq, 0), g3)])
+        q2x, q2y = self._mm([(Tw.f2_conj(q1x, 8), g2), (Tw.f2_conj(q1y, 8), g3)])
+        q2y = Tw.f2_neg(q2y, 8)  # q2 = -psi^2(Q)
         Tpt, line = self._add_step(Tpt, (q1x, q1y), xp, yp)
         f = Tw.f12_mul(f, self._line_f12(line, batch))
         _, line = self._add_step(Tpt, (q2x, q2y), xp, yp)
@@ -220,10 +297,14 @@ class BN254Pairing:
     def final_exp(self, f):
         """f^((p^12-1)/r): easy part by conjugation/Frobenius + one Fp12
         inversion, hard part by the BN addition chain
-        (bn254_ref.final_exponentiation, device form)."""
-        Tw = self.T
+        (bn254_ref.final_exponentiation, device form).
+
+        Resident: runs entirely on joint residues (accumulators hold the
+        f12_mul <= 2^22*p fixed point; conjugation sites pass blog=22,
+        covering every input here)."""
+        Tw = self._Tw
         # easy: f^(p^6-1) = conj(f) * f^-1, then ^(p^2+1)
-        f = Tw.f12_mul(Tw.f12_conj(f), Tw.f12_inv(f))
+        f = Tw.f12_mul(Tw.f12_conj(f, 22), Tw.f12_inv(f))
         f = Tw.f12_mul(Tw.f12_frobenius2(f), f)
 
         # hard part (Scott et al. chain; inversion = conjugation and squaring
@@ -235,12 +316,12 @@ class BN254Pairing:
         fp2 = Tw.f12_frobenius(fp)
         fp3 = Tw.f12_frobenius(fp2)
         y0 = Tw.f12_mul(Tw.f12_mul(fp, fp2), fp3)
-        y1 = Tw.f12_conj(f)
+        y1 = Tw.f12_conj(f, 22)
         y2 = Tw.f12_frobenius2(fu2)
-        y3 = Tw.f12_conj(Tw.f12_frobenius(fu))
-        y4 = Tw.f12_conj(Tw.f12_mul(fu, Tw.f12_frobenius(fu2)))
-        y5 = Tw.f12_conj(fu2)
-        y6 = Tw.f12_conj(Tw.f12_mul(fu3, Tw.f12_frobenius(fu3)))
+        y3 = Tw.f12_conj(Tw.f12_frobenius(fu), 22)
+        y4 = Tw.f12_conj(Tw.f12_mul(fu, Tw.f12_frobenius(fu2)), 22)
+        y5 = Tw.f12_conj(fu2, 22)
+        y6 = Tw.f12_conj(Tw.f12_mul(fu3, Tw.f12_frobenius(fu3)), 22)
 
         t0 = Tw.f12_mul(Tw.f12_mul(Tw.f12_cyclo_sqr(y6), y4), y5)
         t1 = Tw.f12_mul(Tw.f12_mul(y3, y5), t0)
@@ -255,11 +336,19 @@ class BN254Pairing:
     # -- top-level entry points ----------------------------------------------
 
     def pairing(self, p, q, mask=None):
-        """Batched e(P, Q) -> GT; masked lanes give 1."""
-        return self.final_exp(self.miller_loop(p, q, mask))
+        """Batched e(P, Q) -> GT; masked lanes give 1. Resident runs stay
+        in the residue domain across Miller loop AND final exponentiation —
+        one conversion in, one out."""
+        return self._f12_out(self.final_exp(self._miller_loop_res(p, q, mask)))
 
     def gt_is_one(self, f):
-        """(B,) bool: lane-wise comparison against the GT identity."""
+        """(B,) bool: lane-wise comparison against the GT identity.
+
+        Comparison is a positional boundary: a resident element (recognized
+        by its joint-residue row count) reconstructs here — the verdict is
+        the pairing check's single CRT exit."""
+        if self.resident and f[0][0][0].shape[0] == self.F.k_all:
+            f = self._f12_out(f)
         batch = f[0][0][0].shape[1]
         return self.T.f12_eq(f, self.T.f12_one(batch))
 
@@ -271,8 +360,12 @@ class BN254Pairing:
         prod_i e(P_ij, Q_ij) per candidate with ONE shared final
         exponentiation and returns (groups,) bools. Masked-out lanes
         contribute 1 to their candidate's product.
+
+        Resident runs thread the residue form through the per-candidate
+        accumulation and the shared final exponentiation; the only CRT
+        reconstruction is the verdict comparison in `gt_is_one`.
         """
-        f = self.miller_loop(p, q, mask)
+        f = self._miller_loop_res(p, q, mask)
         total = f[0][0][0].shape[1]
         per = total // groups
 
@@ -283,7 +376,7 @@ class BN254Pairing:
 
         acc = slice_chunk(0)
         for i in range(1, per):
-            acc = self.T.f12_mul(acc, slice_chunk(i))
+            acc = self._Tw.f12_mul(acc, slice_chunk(i))
         return self.gt_is_one(self.final_exp(acc))
 
 
@@ -313,28 +406,33 @@ class BLS12Pairing(BN254Pairing):
 
     def _line_f12(self, line, batch):
         c_yp, c_xp, c_const = line
-        z = self.T.f2_zero(batch)
+        z = self._Tw.f2_zero(batch)
         return ((c_const, c_xp, z), (z, c_yp, z))
 
     def _miller_tail(self, Tpt, f, q, xp, yp, batch):
-        # z < 0: f_z = 1/f_{|z|} up to final exp -> conjugate
-        return self.T.f12_conj(f)
+        # z < 0: f_z = 1/f_{|z|} up to final exp -> conjugate (resident:
+        # the scan accumulator sits at the <= 2^22*p fixed point)
+        return self._Tw.f12_conj(f, 22)
 
     def _pow_z(self, x):
         """x^z in the cyclotomic subgroup (z < 0: pow |z|, then conjugate)."""
-        return self.T.f12_conj(self.T.f12_pow_const(x, -bls.Z, cyclo=True))
+        return self._Tw.f12_conj(
+            self._Tw.f12_pow_const(x, -bls.Z, cyclo=True), 22
+        )
 
     def final_exp(self, f):
         """Easy part + BLS12 hard part via
         3(p^4-p^2+1)/r = (z-1)^2 (z+p) (z^2+p^2-1) + 3
         (bls12_381_ref.final_exponentiation, device form with cyclotomic
-        squarings)."""
-        Tw = self.T
-        f = Tw.f12_mul(Tw.f12_conj(f), Tw.f12_inv(f))  # f^(p^6-1)
+        squarings). Resident conj literals: the Miller tail's conjugation
+        leaves f <= 2^23*p (hence blog=23 on the easy part); everything
+        after the easy part returns to the <= 2^22*p mul fixed point."""
+        Tw = self._Tw
+        f = Tw.f12_mul(Tw.f12_conj(f, 23), Tw.f12_inv(f))  # f^(p^6-1)
         f = Tw.f12_mul(Tw.f12_frobenius2(f), f)  # ^(p^2+1)
-        t0 = Tw.f12_mul(self._pow_z(f), Tw.f12_conj(f))  # f^(z-1)
-        t1 = Tw.f12_mul(self._pow_z(t0), Tw.f12_conj(t0))  # f^((z-1)^2)
+        t0 = Tw.f12_mul(self._pow_z(f), Tw.f12_conj(f, 22))  # f^(z-1)
+        t1 = Tw.f12_mul(self._pow_z(t0), Tw.f12_conj(t0, 22))  # f^((z-1)^2)
         g = Tw.f12_mul(self._pow_z(t1), Tw.f12_frobenius(t1))  # ^(z+p)
         gz2 = self._pow_z(self._pow_z(g))
-        h = Tw.f12_mul(Tw.f12_mul(gz2, Tw.f12_frobenius2(g)), Tw.f12_conj(g))
+        h = Tw.f12_mul(Tw.f12_mul(gz2, Tw.f12_frobenius2(g)), Tw.f12_conj(g, 22))
         return Tw.f12_mul(h, Tw.f12_mul(Tw.f12_cyclo_sqr(f), f))  # * f^3

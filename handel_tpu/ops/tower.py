@@ -11,7 +11,14 @@ so the Pallas kernel's lanes stay full even for small pairing batches
 (ops/fp.py "batch stacking beats vmap"). Elements are pytrees of (nlimbs, B)
 uint32 arrays: Fp2 = (c0, c1), Fp6 = (Fp2, Fp2, Fp2), Fp12 = (Fp6, Fp6).
 
-All values Montgomery-form, canonical (< p).
+All values Montgomery-form, canonical (< p) — EXCEPT under the resident
+field adapter (`Tower.as_resident()`, ops/rns.py `ResidentRns`), where every
+coordinate is a (k_all, B) int32 joint-residue array bounded by 2^lb * p for
+a statically-tracked exponent lb. The tower formulas are representation-
+agnostic; the only resident-specific obligation is the `blog` literal passed
+at each subtraction/negation site — the static bound exponent of the
+subtrahend at that site, derived once by the bound walk in HACKING.md
+"Residue-resident pairing" and simply ignored by the positional backends.
 """
 
 from __future__ import annotations
@@ -70,10 +77,12 @@ class Tower:
             return [self.F.add(lhs[0], rhs[0])]
         return self._split(self.F.add(self._cat(lhs), self._cat(rhs)), len(lhs))
 
-    def _sub_n(self, lhs, rhs):
+    def _sub_n(self, lhs, rhs, blog=None):
         if len(lhs) == 1:
-            return [self.F.sub(lhs[0], rhs[0])]
-        return self._split(self.F.sub(self._cat(lhs), self._cat(rhs)), len(lhs))
+            return [self.F.sub(lhs[0], rhs[0], blog)]
+        return self._split(
+            self.F.sub(self._cat(lhs), self._cat(rhs), blog), len(lhs)
+        )
 
     # -- Fp2 ---------------------------------------------------------------
 
@@ -82,18 +91,18 @@ class Tower:
         c0, c1 = self._split(c, 2)
         return (c0, c1)
 
-    def f2_sub(self, a, b):
-        c = self.F.sub(self._cat([a[0], a[1]]), self._cat([b[0], b[1]]))
+    def f2_sub(self, a, b, blog=None):
+        c = self.F.sub(self._cat([a[0], a[1]]), self._cat([b[0], b[1]]), blog)
         c0, c1 = self._split(c, 2)
         return (c0, c1)
 
-    def f2_neg(self, a):
+    def f2_neg(self, a, blog=None):
         z = self._cat([a[0], a[1]])
-        c0, c1 = self._split(self.F.sub(jnp.zeros_like(z), z), 2)
+        c0, c1 = self._split(self.F.sub(jnp.zeros_like(z), z, blog), 2)
         return (c0, c1)
 
-    def f2_conj(self, a):
-        return (a[0], self.F.neg(a[1]))
+    def f2_conj(self, a, blog=None):
+        return (a[0], self.F.neg(a[1], blog))
 
     def f2_add_many(self, pairs):
         """[(a+b)] for a list of Fp2 pairs — one Field.add total."""
@@ -104,10 +113,11 @@ class Tower:
         k = len(pairs)
         return [(out[i], out[k + i]) for i in range(k)]
 
-    def f2_sub_many(self, pairs):
+    def f2_sub_many(self, pairs, blog=None):
         out = self._sub_n(
             [p[0][0] for p in pairs] + [p[0][1] for p in pairs],
             [p[1][0] for p in pairs] + [p[1][1] for p in pairs],
+            blog,
         )
         k = len(pairs)
         return [(out[i], out[k + i]) for i in range(k)]
@@ -115,6 +125,10 @@ class Tower:
     def f2_mul(self, a, b):
         """Karatsuba: 3 base muls in one stacked call.
         (a0+a1 i)(b0+b1 i) = (a0b0 - a1b1) + ((a0+a1)(b0+b1) - a0b0 - a1b1) i
+
+        Resident bounds: products out <= 2^6*p, so the subtrahends (v1, v0
+        and then v1) sit at blog=6 and the outputs land at (c0 <= 2^7*p,
+        c1 <= 2^8*p). Operand constraint: la + lb <= 54.
         """
         F = self.F
         s = F.add(self._cat([a[0], b[0]]), self._cat([a[1], b[1]]))
@@ -122,16 +136,21 @@ class Tower:
         lhs = self._cat([a[0], a[1], sa])
         rhs = self._cat([b[0], b[1], sb])
         v0, v1, v2 = _split3(F.mul(lhs, rhs))
-        d = F.sub(self._cat([v0, v2]), self._cat([v1, v0]))
+        d = F.sub(self._cat([v0, v2]), self._cat([v1, v0]), 6)
         c0, t = self._split(d, 2)
-        c1 = F.sub(t, v1)
+        c1 = F.sub(t, v1, 6)
         return (c0, c1)
 
     def f2_sqr(self, a):
-        """(a0+a1 i)^2 = (a0+a1)(a0-a1) + 2 a0 a1 i — 2 base muls."""
+        """(a0+a1 i)^2 = (a0+a1)(a0-a1) + 2 a0 a1 i — 2 base muls.
+
+        Resident bounds: the internal a0 - a1 uses the universal blog=24
+        offset (every tower call site keeps coordinates <= 2^24*p; input
+        constraint la <= 24 so (la+1) + 25 stays inside RES_MUL_LOG2). Out
+        (c0 <= 2^6*p, c1 <= 2^7*p)."""
         F = self.F
         m = F.add(a[0], a[1])
-        s = F.sub(a[0], a[1])
+        s = F.sub(a[0], a[1], 24)
         prod = F.mul(self._cat([m, a[0]]), self._cat([s, a[1]]))
         c0, t = self._split(prod, 2)
         return (c0, F.add(t, t))
@@ -161,38 +180,47 @@ class Tower:
         z8 = F.add(z4, z4)
         return F.add(z8, z)
 
-    def f2_mul_xi(self, a):
+    def f2_mul_xi(self, a, blog=None):
         """Multiply by the Fp6 non-residue via add chains (no base mul).
         xi = 9+i (BN254): (9a0 - a1, 9a1 + a0), one stacked x9 chain;
-        xi = 1+i (BLS12-381): (a0 - a1, a0 + a1)."""
+        xi = 1+i (BLS12-381): (a0 - a1, a0 + a1).
+
+        Resident: `blog` is the INPUT bound exponent (the subtrahend is an
+        input coordinate); output bound la + 5 for xi = 9+i (the x9 chain
+        adds 4, the sub 1), la + 1 for xi = 1+i."""
         F = self.F
         if self.xi == (1, 1):
-            return (F.sub(a[0], a[1]), F.add(a[0], a[1]))
+            return (F.sub(a[0], a[1], blog), F.add(a[0], a[1]))
         n9 = self._x9(self._cat([a[0], a[1]]))
         n90, n91 = self._split(n9, 2)
-        return (F.sub(n90, a[1]), F.add(n91, a[0]))
+        return (F.sub(n90, a[1], blog), F.add(n91, a[0]))
 
-    def f2_mul_xi_many(self, elems):
-        """xi * e for a list of Fp2 elements — one stacked chain."""
+    def f2_mul_xi_many(self, elems, blog=None):
+        """xi * e for a list of Fp2 elements — one stacked chain. `blog`
+        bounds the WIDEST input element (resident mode)."""
         k = len(elems)
         c0s = self._cat([e[0] for e in elems])
         c1s = self._cat([e[1] for e in elems])
         if self.xi == (1, 1):
-            d = self.F.sub(c0s, c1s)
+            d = self.F.sub(c0s, c1s, blog)
             s = self.F.add(c0s, c1s)
             return list(zip(self._split(d, k), self._split(s, k)))
         n9 = self._x9(self._cat([c0s, c1s]))
         parts = self._split(n9, 2 * k)
-        d = self.F.sub(self._cat(parts[:k]), c1s)
+        d = self.F.sub(self._cat(parts[:k]), c1s, blog)
         s = self.F.add(self._cat(parts[k:]), c0s)
         return list(zip(self._split(d, k), self._split(s, k)))
 
     def f2_inv(self, a):
-        """1/(a0+a1 i) = (a0 - a1 i)/(a0^2+a1^2)."""
+        """1/(a0+a1 i) = (a0 - a1 i)/(a0^2+a1^2).
+
+        Resident: den <= 2^7*p feeds the Fermat chain (bounds stay <= 2^7*p
+        throughout — see ResidentRns.pow_const); products cap at 2^6*p, so
+        the final negation's subtrahend sits at blog=6."""
         F = self.F
         den = F.add(F.mul(a[0], a[0]), F.mul(a[1], a[1]))
         inv = F.inv(den)
-        return (F.mul(a[0], inv), F.neg(F.mul(a[1], inv)))
+        return (F.mul(a[0], inv), F.neg(F.mul(a[1], inv), 6))
 
     def f2_select(self, mask, a, b):
         return (self.F.select(mask, a[0], b[0]), self.F.select(mask, a[1], b[1]))
@@ -204,11 +232,16 @@ class Tower:
         return self.F.is_zero(a[0]) & self.F.is_zero(a[1])
 
     def f2_zero(self, batch: int):
-        z = jnp.zeros((self.F.nlimbs, batch), jnp.uint32)
+        # F.limb_dtype keeps lax.scan carries dtype-consistent across
+        # representations (uint32 positional limbs, int32 residue rows)
+        z = jnp.zeros((self.F.nlimbs, batch), self.F.limb_dtype)
         return (z, z)
 
     def f2_one(self, batch: int):
-        return (self.F.constant(1, batch), jnp.zeros((self.F.nlimbs, batch), jnp.uint32))
+        return (
+            self.F.constant(1, batch),
+            jnp.zeros((self.F.nlimbs, batch), self.F.limb_dtype),
+        )
 
     def f2_constant(self, c, batch: int):
         """Embed a bn254_ref Fp2 value (int pair) as broadcast limbs."""
@@ -239,13 +272,13 @@ class Tower:
         out = self.f2_add_many(list(zip(a, b)))
         return tuple(out)
 
-    def f6_sub(self, a, b):
-        out = self.f2_sub_many(list(zip(a, b)))
+    def f6_sub(self, a, b, blog=None):
+        out = self.f2_sub_many(list(zip(a, b)), blog)
         return tuple(out)
 
-    def f6_neg(self, a):
+    def f6_neg(self, a, blog=None):
         z = self._cat([a[i][j] for i in range(3) for j in range(2)])
-        parts = self._split(self.F.sub(jnp.zeros_like(z), z), 6)
+        parts = self._split(self.F.sub(jnp.zeros_like(z), z, blog), 6)
         return ((parts[0], parts[1]), (parts[2], parts[3]), (parts[4], parts[5]))
 
     def f6_mul(self, a, b):
@@ -260,27 +293,36 @@ class Tower:
         lhs = self._f2_stack([a0, a1, a2, s[0], s[1], s[2]])
         rhs = self._f2_stack([b0, b1, b2, s[3], s[4], s[5]])
         t0, t1, t2, u0, u1, u2 = self._f2_unstack(self.f2_mul(lhs, rhs), 6)
-        # pairwise t-sums, then u - sums, in one call each
+        # pairwise t-sums, then u - sums, in one call each. Resident bounds
+        # (operand constraint max(la, lb) <= 26): products t, u <= 2^8*p,
+        # w <= 2^9*p, d <= 2^10*p, xi-folds <= 2^15*p, out <= 2^16*p.
         w = self.f2_add_many([(t1, t2), (t0, t1), (t0, t2)])
-        d0, d1, d2 = self.f2_sub_many([(u0, w[0]), (u1, w[1]), (u2, w[2])])
-        x0, x2 = self.f2_mul_xi_many([d0, t2])  # xi*(u0-t1-t2), xi*t2
+        d0, d1, d2 = self.f2_sub_many([(u0, w[0]), (u1, w[1]), (u2, w[2])], 9)
+        x0, x2 = self.f2_mul_xi_many([d0, t2], 10)  # xi*(u0-t1-t2), xi*t2
         c0, c1, c2 = self.f2_add_many([(t0, x0), (d1, x2), (d2, t1)])
         return (c0, c1, c2)
 
-    def f6_mul_v(self, a):
-        """(c0,c1,c2) * v = (xi*c2, c0, c1)."""
-        return (self.f2_mul_xi(a[2]), a[0], a[1])
+    def f6_mul_v(self, a, blog=None):
+        """(c0,c1,c2) * v = (xi*c2, c0, c1). `blog` bounds a[2] (resident)."""
+        return (self.f2_mul_xi(a[2], blog), a[0], a[1])
 
     def f6_inv(self, a):
-        """bn254_ref.f6_inv structure."""
+        """bn254_ref.f6_inv structure. Resident bound walk (input <= 2^22*p,
+        the f12_inv feed): squares <= 2^7*p, products <= 2^8*p, xi-folds
+        <= 2^13*p, so t0 <= 2^14*p, t1 <= 2^13*p, t2 <= 2^9*p, den <=
+        2^15*p — every product constraint inside RES_MUL_LOG2."""
         a0, a1, a2 = a
-        t0 = self.f2_sub(self.f2_sqr(a0), self.f2_mul_xi(self.f2_mul(a1, a2)))
-        t1 = self.f2_sub(self.f2_mul_xi(self.f2_sqr(a2)), self.f2_mul(a0, a1))
-        t2 = self.f2_sub(self.f2_sqr(a1), self.f2_mul(a0, a2))
+        t0 = self.f2_sub(
+            self.f2_sqr(a0), self.f2_mul_xi(self.f2_mul(a1, a2), 8), 13
+        )
+        t1 = self.f2_sub(
+            self.f2_mul_xi(self.f2_sqr(a2), 7), self.f2_mul(a0, a1), 8
+        )
+        t2 = self.f2_sub(self.f2_sqr(a1), self.f2_mul(a0, a2), 8)
         den = self.f2_add(
             self.f2_mul(a0, t0),
             self.f2_mul_xi(
-                self.f2_add(self.f2_mul(a2, t1), self.f2_mul(a1, t2))
+                self.f2_add(self.f2_mul(a2, t1), self.f2_mul(a1, t2)), 9
             ),
         )
         inv = self.f2_inv(den)
@@ -299,7 +341,12 @@ class Tower:
 
     def f12_mul(self, a, b):
         """Karatsuba over Fp6: 3 Fp6 muls -> one stacked f6_mul (54x batch);
-        the six karatsuba input sums in one add call."""
+        the six karatsuba input sums in one add call.
+
+        Resident bounds (operand constraint max coords <= 2^25*p): f6_mul
+        outputs v <= 2^16*p, so c0 <= 2^22*p and c1 <= 2^18*p — i.e.
+        f12_mul(f, g) with coords <= 2^22*p lands back at <= 2^22*p, the
+        stable fixed point the Miller/final-exp accumulators live at."""
         a0, a1 = a
         b0, b1 = b
         s = self.f2_add_many(
@@ -310,10 +357,10 @@ class Tower:
         prod = self.f6_mul(lhs, rhs)
         v0, v1, v2 = zip(*(self._f2_unstack(c, 3) for c in prod))
         v0, v1, v2 = tuple(v0), tuple(v1), tuple(v2)
-        c0 = self.f6_add(v0, self.f6_mul_v(v1))
+        c0 = self.f6_add(v0, self.f6_mul_v(v1, 16))
         # c1 = v2 - v0 - v1: six components, two stacked sub calls
-        d = self.f2_sub_many(list(zip(v2, v0)))
-        c1 = tuple(self.f2_sub_many(list(zip(d, v1))))
+        d = self.f2_sub_many(list(zip(v2, v0)), 16)
+        c1 = tuple(self.f2_sub_many(list(zip(d, v1)), 16))
         return (c0, tuple(c1))
 
     def f12_sqr(self, a):
@@ -336,6 +383,14 @@ class Tower:
         (= 18 base muls) vs the generic f12_sqr's 54. The 2ab terms come from
         (lo+hi)^2 - lo^2 - hi^2 so no extra multiply is spent on them.
         """
+        if getattr(self.F, "is_resident", False):
+            # reset the accumulator's bound before squaring: the cyclo
+            # formula subtracts INPUT coordinates from derived terms, so it
+            # converges only from a small input bound. One stacked refresh
+            # (12 coords wide) drops any bound <= RES_MUL_LOG2 to <= 2^6*p
+            # without leaving the residue domain; bound walk proceeds from
+            # there to an output <= 2^18*p.
+            a = self._f12_refresh(a)
         x0, x1, x2 = a[0]
         x3, x4, x5 = a[1]
         s40, s23, s51 = self.f2_add_many([(x4, x0), (x2, x3), (x5, x1)])
@@ -343,13 +398,13 @@ class Tower:
             [x4, x0, s40, x2, x3, s23, x5, x1, s51]
         )
         # cross terms 2*x4*x0, 2*x2*x3, 2*x5*x1
-        d = self.f2_sub_many([(q40, q4), (q23, q2), (q51, q5)])
-        t6, t7, t8 = self.f2_sub_many([(d[0], q0), (d[1], q3), (d[2], q1)])
+        d = self.f2_sub_many([(q40, q4), (q23, q2), (q51, q5)], 7)
+        t6, t7, t8 = self.f2_sub_many([(d[0], q0), (d[1], q3), (d[2], q1)], 7)
         # xi-folded Fp4 squares (one xi add-chain for all four)
-        xt8, xt4, xt2, xt5 = self.f2_mul_xi_many([t8, q4, q2, q5])
+        xt8, xt4, xt2, xt5 = self.f2_mul_xi_many([t8, q4, q2, q5], 9)
         u0, u1, u2 = self.f2_add_many([(xt4, q0), (xt2, q3), (xt5, q1)])
         # z = 3u - 2x (C0) / 3t + 2x (C1), via (u -/+ x) doubled + u
-        w = self.f2_sub_many([(u0, x0), (u1, x1), (u2, x2)])
+        w = self.f2_sub_many([(u0, x0), (u1, x1), (u2, x2)], 6)
         w += self.f2_add_many([(xt8, x3), (t6, x4), (t7, x5)])
         w2 = self.f2_add_many([(t, t) for t in w])
         z = self.f2_add_many(
@@ -360,16 +415,24 @@ class Tower:
     def f12_add(self, a, b):
         return (self.f6_add(a[0], b[0]), self.f6_add(a[1], b[1]))
 
-    def f12_conj(self, a):
-        return (a[0], self.f6_neg(a[1]))
+    def f12_conj(self, a, blog=None):
+        return (a[0], self.f6_neg(a[1], blog))
 
     def f12_inv(self, a):
+        """Resident bounds (input <= 2^22*p): f6 squares <= 2^16*p, the
+        mul_v fold <= 2^21*p, f6_inv input <= 2^22*p, output products <=
+        2^16*p."""
         den = self.f6_inv(
             self.f6_sub(
-                self._f6_sqr_via_mul(a[0]), self.f6_mul_v(self._f6_sqr_via_mul(a[1]))
+                self._f6_sqr_via_mul(a[0]),
+                self.f6_mul_v(self._f6_sqr_via_mul(a[1]), 16),
+                21,
             )
         )
-        return (self.f6_mul(a[0], den), self.f6_neg(self.f6_mul(a[1], den)))
+        return (
+            self.f6_mul(a[0], den),
+            self.f6_neg(self.f6_mul(a[1], den), 16),
+        )
 
     def _f6_sqr_via_mul(self, a):
         return self.f6_mul(a, a)
@@ -396,6 +459,32 @@ class Tower:
     def _flatten12(self, a):
         return [a[i][j][k] for i in range(2) for j in range(3) for k in range(2)]
 
+    def _f12_refresh(self, a):
+        """Resident-only: reset all 12 coordinate bounds to <= 2^6*p in ONE
+        stacked refresh (a single mul_resident by the Montgomery one at 12x
+        batch width — same batch-stacking discipline as the muls)."""
+        parts = self._split(self.F.refresh(self._cat(self._flatten12(a))), 12)
+        return (
+            ((parts[0], parts[1]), (parts[2], parts[3]), (parts[4], parts[5])),
+            ((parts[6], parts[7]), (parts[8], parts[9]), (parts[10], parts[11])),
+        )
+
+    def as_resident(self) -> "Tower":
+        """A Tower over the resident form of this tower's RNS field: same
+        formulas, values stay joint-residue arrays end to end (CRT deferred
+        to the caller's genuine boundaries). Gammas and embedded constants
+        re-pack through the adapter at construction. Cached."""
+        if not hasattr(self.F, "resident"):
+            raise TypeError(
+                f"as_resident() needs the 'rns' field backend; this tower's "
+                f"field is {self.F.backend!r}"
+            )
+        cached = getattr(self, "_resident_tower", None)
+        if cached is None:
+            cached = Tower(self.F.resident(), params=self.params)
+            self._resident_tower = cached
+        return cached
+
     def f12_frobenius(self, a):
         """x -> x^p (bn254_ref.f12_frobenius structure: conjugate each Fp2
         coordinate, multiply w-degree-j slots by gamma_j). All six
@@ -404,7 +493,9 @@ class Tower:
         batch = c00[0].shape[1]
         coords = [c00, c01, c02, c10, c11, c12]
         z = self._cat([c[1] for c in coords])
-        negs = self._split(self.F.sub(jnp.zeros_like(z), z), 6)
+        # resident: every Frobenius call site (final exp) holds coords at
+        # the <= 2^22*p accumulator fixed point — blog=22 covers them all
+        negs = self._split(self.F.sub(jnp.zeros_like(z), z, 22), 6)
         conj = [(coords[i][0], negs[i]) for i in range(6)]
 
         def g(j):
@@ -414,6 +505,18 @@ class Tower:
                 jnp.broadcast_to(g1, (self.F.nlimbs, batch)),
             )
 
+        if getattr(self.F, "is_resident", False):
+            # multiply the w^0 slot by one as well (6-wide instead of
+            # 5-wide — same single f2_mul launch) so EVERY output slot is a
+            # product with its bound reset to <= 2^8*p; leaving the slot as
+            # a raw conjugate would let bounds accumulate across chained
+            # Frobenius applications (fp3 = frobenius^3 in the final exp)
+            lhs = self._f2_stack(conj)
+            rhs = self._f2_stack([self.f2_one(batch), g(2), g(4), g(1), g(3), g(5)])
+            m00, m01, m02, m10, m11, m12 = self._f2_unstack(
+                self.f2_mul(lhs, rhs), 6
+            )
+            return ((m00, m01, m02), (m10, m11, m12))
         lhs = self._f2_stack(conj[1:])
         rhs = self._f2_stack([g(2), g(4), g(1), g(3), g(5)])
         m01, m02, m10, m11, m12 = self._f2_unstack(self.f2_mul(lhs, rhs), 5)

@@ -76,9 +76,15 @@ def launch_partition_rules(axis: str = "dp"):
     coordinates, prefix table) shard their point axis; the per-launch
     candidate mask shards its registry-major rows with them; everything
     per-candidate (signatures, H(m), validity, range bounds) stays
-    replicated — `sharded_pairing_check` re-shards candidates itself."""
+    replicated — `sharded_pairing_check` re-shards candidates itself.
+
+    Resident residue planes (ops/rns.py `to_resident`) are (k_all, B)
+    like positional limb arrays — batch-last — so any operand spelled
+    `res_*` / `resident_*` shards its trailing batch axis the same way
+    the registry banks do."""
     return (
         (r"^(reg|prefix)", P(None, axis)),
+        (r"^res(ident)?_", P(None, axis)),
         (r"^mask$", P(axis, None)),
         (r"", P()),
     )

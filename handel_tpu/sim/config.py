@@ -327,6 +327,12 @@ class SimConfig:
     # "rns" (residue-number-system MXU pipeline, ops/rns.py); plumbed
     # node -> new_scheme -> models/*_jax.py -> ops/curve.py -> ops/fp.py
     fp_backend: str = "cios"
+    # With fp_backend = "rns": keep pairing values resident as residue
+    # planes across the Miller loop / final exponentiation, reconstructing
+    # through the CRT only at line boundaries (ops/pairing.py). Ignored by
+    # "cios". `true` is the optimized default; `false` forces the legacy
+    # per-mul round-trip form for debugging.
+    rns_resident: bool = True
     debug: bool = False
     # live telemetry plane (core/metrics.py): every node process serves
     # /metrics + /healthz + /readyz on its own port (allocated by the
@@ -378,6 +384,7 @@ def load_config(path: str) -> SimConfig:
         shared_verifier=bool(raw.get("shared_verifier", False)),
         mesh_devices=int(raw.get("mesh_devices", 1)),
         fp_backend=str(raw.get("fp_backend", "cios")),
+        rns_resident=bool(raw.get("rns_resident", True)),
         debug=bool(raw.get("debug", False)),
         metrics=bool(raw.get("metrics", False)),
         metrics_linger_s=float(raw.get("metrics_linger_s", 0.0)),
@@ -422,8 +429,10 @@ def load_config(path: str) -> SimConfig:
         "", "cios", "rns",
     ):
         raise ValueError(
-            f"fp_backend must be 'cios' or 'rns', got "
-            f"{cfg.fp_backend!r} / service {cfg.service.fp_backend!r}"
+            f"fp_backend must be one of 'cios', 'rns', got "
+            f"{cfg.fp_backend!r} / service {cfg.service.fp_backend!r} "
+            "(the 'rns' backend additionally honours the boolean "
+            "`rns_resident` knob for residue-resident pairing)"
         )
     so = raw.get("soak", {})
     cfg.soak = SoakParams(
@@ -528,6 +537,7 @@ def dump_config(cfg: SimConfig) -> str:
         f"shared_verifier = {str(cfg.shared_verifier).lower()}",
         f"mesh_devices = {cfg.mesh_devices}",
         f'fp_backend = "{cfg.fp_backend}"',
+        f"rns_resident = {str(cfg.rns_resident).lower()}",
         f"debug = {str(cfg.debug).lower()}",
         f"metrics = {str(cfg.metrics).lower()}",
         f"metrics_linger_s = {cfg.metrics_linger_s}",

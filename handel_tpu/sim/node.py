@@ -104,6 +104,12 @@ async def run_node_process(args) -> int:
                 "batch_size": cfg.batch_size,
                 "mesh_devices": cfg.mesh_devices,
                 "fp_backend": cfg.fp_backend,
+                # residency only means something on the rns backend; None
+                # lets the pairing layer auto-detect (and avoids the
+                # explicit-True-on-cios error)
+                "rns_resident": (
+                    cfg.rns_resident if cfg.fp_backend == "rns" else None
+                ),
             }
             if is_device_scheme(cfg.scheme)
             else {}

@@ -88,12 +88,22 @@ SIDE_METRICS = {
     # ops/fp.py chained_marginal): captured once per Field backend
     # (CIOS, RNS) under the same chained-dispatch methodology
     "mont_muls_per_s": "higher",
+    # residue-resident pairing (bench.py _pairing_bench / ops/pairing.py):
+    # p50 wall of a batch-4 full pairing per Field backend, and the CRT
+    # boundary crossings per pairing trace (resident form: O(line
+    # boundaries); legacy: once per tower mul)
+    "pairing_p50_ms": "lower",
+    "rns_conversions_per_pairing": "lower",
 }
 
 # Metrics that exist once per Field backend. Their comparison key grows a
 # "/<fp_backend>" suffix so a CIOS row is never judged against an RNS row
 # (the per-backend like-for-like rule, same spirit as tpu-vs-cpu refusal).
-PER_FP_BACKEND = {"mont_muls_per_s"}
+PER_FP_BACKEND = {
+    "mont_muls_per_s",
+    "pairing_p50_ms",
+    "rns_conversions_per_pairing",
+}
 
 
 def normalize(obj: dict) -> dict | None:

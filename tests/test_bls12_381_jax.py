@@ -29,9 +29,12 @@ from handel_tpu.ops.pairing import BLS12Pairing
 B = 4  # lane count shared by every test
 
 
-@pytest.fixture(scope="module")
-def stack():
-    curves = BLS12Curves()
+@pytest.fixture(scope="module", params=["cios", "rns"])
+def stack(request):
+    """Both Field backends; the rns param runs the residue-resident
+    pairing (BLS12-381 bound walk: M-type twist lines, the z-power
+    conjugate chain) against the same oracle assertions."""
+    curves = BLS12Curves(backend=request.param)
     return curves, BLS12Pairing(curves)
 
 

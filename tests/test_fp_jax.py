@@ -241,6 +241,83 @@ def test_rns_crt_roundtrip_full_range(Frns):
         assert rec == v, f"CRT round-trip broke at {v:#x}"
 
 
+def test_resident_chain_bit_exact_near_p(Frns, Fcios):
+    """Residue-RESIDENT chains (residue-resident pairing) over seeded and
+    near-p operands: mul -> add -> sub(blog) -> mul stays in the residue
+    domain throughout and reconstructs ONCE; the boundary limbs must be
+    bit-identical to the CIOS backend computing the same chain
+    positionally."""
+    A = Frns.resident()
+    xs = rand_elems(6) + [bn.P - 1, bn.P - 1]
+    ys = [bn.P - 1 - k for k in range(6)] + [1, bn.P - 1]
+
+    def chain_resident():
+        a, b = A.pack(xs), A.pack(ys)
+        c = A.mul(a, b)
+        d = A.add(c, a)
+        e = A.sub(d, b, 7)
+        # the two backends carry different Montgomery constants (M vs R):
+        # bit-identity is contracted at the CANONICAL boundary, after
+        # from_mont strips the backend's own constant
+        return Frns.from_mont(Frns.from_resident(A.mul(e, c)))
+
+    def chain_cios():
+        a, b = Fcios.pack(xs), Fcios.pack(ys)
+        c = Fcios.mul(a, b)
+        d = Fcios.add(c, a)
+        e = Fcios.sub(d, b)
+        return Fcios.from_mont(Fcios.mul(e, c))
+
+    r_out = jax.jit(chain_resident)()
+    c_out = jax.jit(chain_cios)()
+    assert np.array_equal(np.asarray(r_out), np.asarray(c_out))
+    want = [
+        (x * y % bn.P + x - y) * (x * y) % bn.P for x, y in zip(xs, ys)
+    ]
+    assert Frns.unpack(jnp.asarray(r_out), mont=False) == want
+
+
+def test_resident_pairing_line_boundary(Frns, Fcios):
+    """The pairing's genuine boundary shape, computed RESIDENT: the
+    sparse-line expression l = a*b + c*d + e accumulates in residues and
+    crosses the CRT exactly once at the end — bit-identical to the CIOS
+    backend paying positional form at every hop. Near-p operands push the
+    Montgomery-quotient overshoot to its worst case."""
+    A = Frns.resident()
+    vals = rand_elems(4) + [bn.P - 1, bn.P - 2, 1, bn.P - 1]
+    rev = list(reversed(vals))
+
+    def line_resident():
+        a, b = A.pack(vals), A.pack(rev)
+        t1 = A.mul(a, b)
+        t2 = A.mul(A.add(t1, a), A.sub(t1, b, 7))
+        out = A.add(A.mul(t2, A.refresh(t1)), a)
+        return Frns.from_mont(Frns.from_resident(out))
+
+    def line_cios():
+        a, b = Fcios.pack(vals), Fcios.pack(rev)
+        t1 = Fcios.mul(a, b)
+        t2 = Fcios.mul(Fcios.add(t1, a), Fcios.sub(t1, b))
+        return Fcios.from_mont(Fcios.add(Fcios.mul(t2, t1), a))
+
+    assert np.array_equal(
+        np.asarray(jax.jit(line_resident)()),
+        np.asarray(jax.jit(line_cios)()),
+    )
+
+
+def test_resident_inv_and_pow(Frns):
+    """The adapter's Fermat inverse and windowed pow on resident values,
+    against python pow — the exponent path the final-exp tower leans on."""
+    A = Frns.resident()
+    xs = rand_elems(3) + [bn.P - 1]
+    a = A.pack(xs)
+    got = A.unpack(jax.jit(A.inv)(a))
+    assert got == [pow(x, -1, bn.P) for x in xs]
+    got = A.unpack(jax.jit(lambda v: A.pow_const(v, 0x113, window=4))(a))
+    assert got == [pow(x, 0x113, bn.P) for x in xs]
+
+
 def test_rns_exact_at_pairing_line_boundary(Frns, Fcios):
     """The pairing consumes positional form at line evaluations: chains of
     mul -> add -> mul (each mul paying a full CRT reconstruction). A

@@ -26,9 +26,14 @@ from handel_tpu.ops.pairing import BN254Pairing
 B = 4  # lane count shared by every test
 
 
-@pytest.fixture(scope="module")
-def stack():
-    curves = BN254Curves()
+@pytest.fixture(scope="module", params=["cios", "rns"])
+def stack(request):
+    """Both Field backends through the SAME oracle assertions. The rns
+    param auto-enables the residue-resident pairing (ops/pairing.py):
+    the Miller loop and final exponentiation stay residue planes, with
+    CRT reconstruction only at the line boundaries — so these tests gate
+    the resident form bit-exactly against the scalar oracle."""
+    curves = BN254Curves(backend=request.param)
     return curves, BN254Pairing(curves)
 
 

@@ -143,3 +143,153 @@ def test_config_plumbing_to_field():
     # default stays the CIOS oracle
     sch_c = new_scheme("bn254-jax", batch_size=4, warmup=False)
     assert sch_c.constructor.curves.F.backend == "cios"
+
+
+# -- residue-resident value form (residue-resident pairing) -------------------
+
+
+def test_resident_closure_invariants(F):
+    """Construction-time bounds the resident exactness argument rests on:
+    base A holds 2^RES_MUL_LOG2 * p of head-room (so fused tower chains
+    never overflow the Montgomery-quotient tolerance), the quotient row
+    count keeps the int32 discipline, and the broadcast constants
+    (Montgomery one, subtract offsets) are the right residues."""
+    assert F.M >= (1 << F.RES_MUL_LOG2) * F.p
+    assert F.kA + 1 <= 64
+    m_all = [int(m) for m in F._m_all]
+    assert list(F._one_res) == [(F.M % F.p) % m for m in m_all]
+    assert F._off_res.shape == (F.RES_MAX_BLOG + 1, F.k_all)
+    for s in (0, 7, F.RES_MAX_BLOG):
+        assert list(F._off_res[s]) == [(F.p << s) % m for m in m_all]
+
+
+def test_resident_ops_bit_exact(F):
+    """Seeded chain through every resident primitive — mul, add, sub (with
+    offset), refresh — against python ints, reconstructed ONCE at the end;
+    plus the from_resident boundary bit-identical to canonical limbs."""
+    A = F.resident()
+    rng = np.random.default_rng(16)
+    xs = [int.from_bytes(rng.bytes(32), "little") % bn.P for _ in range(6)]
+    xs += [0, bn.P - 1]
+    ys = list(reversed(xs))
+    a, b = A.pack(xs), A.pack(ys)
+    # c = x*y (bound 6); d = c + x (7); e = d - y + off (8); f = e * c (6)
+    c = A.mul(a, b)
+    d = A.add(c, a)
+    e = A.sub(d, b, 7)
+    f = A.mul(e, A.refresh(c))
+    got = A.unpack(f)
+    want = [
+        (x * y % bn.P + x - y) * (x * y % bn.P) % bn.P
+        for x, y in zip(xs, ys)
+    ]
+    assert got == want
+    # boundary limbs bit-identical to a straight canonical pack
+    limbs = F.from_resident(f)
+    assert np.array_equal(np.asarray(limbs), np.asarray(F.pack(got)))
+
+
+def test_resident_adapter_contracts(F):
+    """The contracts the tower relies on: sub/neg demand a static blog
+    literal inside the offset table; eq/is_zero are refused (positional
+    boundaries by definition); constant() embeds without counting a
+    conversion; select keeps the int32 residue dtype."""
+    A = F.resident()
+    a, b = A.pack([3, 5]), A.pack([1, 2])
+    with pytest.raises(ValueError):
+        F.sub_resident(a, b, None)
+    with pytest.raises(ValueError):
+        F.sub_resident(a, b, F.RES_MAX_BLOG + 1)
+    with pytest.raises(RuntimeError):
+        A.eq(a, b)
+    with pytest.raises(RuntimeError):
+        A.is_zero(a)
+    before = F.conversion_counts()["total"]
+    one = A.constant(1, 2)
+    assert F.conversion_counts()["total"] == before
+    assert one.dtype == jnp.int32 and one.shape == (F.k_all, 2)
+    assert A.unpack(A.mul(a, one)) == [3, 5]  # Montgomery identity
+    sel = A.select(jnp.asarray([True, False]), a, b)
+    assert sel.dtype == jnp.int32 and A.unpack(sel) == [3, 2]
+
+
+def test_resident_conversion_counters(F):
+    """to/from_resident count one boundary crossing each at trace time;
+    the legacy positional mul models its inherent round trip as one of
+    each per call."""
+    A = F.resident()
+    F.reset_conversion_counts()
+    a = A.pack([7, 11])
+    assert F.conversion_counts() == {
+        "to_resident": 1, "from_resident": 0, "total": 1,
+    }
+    A.mul(a, a)  # resident ops never convert
+    assert F.conversion_counts()["total"] == 1
+    A.unpack(a)
+    assert F.conversion_counts() == {
+        "to_resident": 1, "from_resident": 1, "total": 2,
+    }
+    F.reset_conversion_counts()
+    x = F.pack([7, 11])
+    F.mul(x, x)
+    assert F.conversion_counts() == {
+        "to_resident": 1, "from_resident": 1, "total": 2,
+    }
+    F.reset_conversion_counts()
+
+
+def test_resident_pairing_knob():
+    """BN254Pairing residency: auto-on for an rns Field, off for cios, and
+    an explicit resident=True on a positional backend is refused with the
+    fix named."""
+    from handel_tpu.ops.curve import BN254Curves
+    from handel_tpu.ops.pairing import BN254Pairing
+
+    assert BN254Pairing(BN254Curves(backend="rns")).resident
+    assert not BN254Pairing(BN254Curves(backend="cios")).resident
+    with pytest.raises(ValueError, match="rns"):
+        BN254Pairing(BN254Curves(backend="cios"), resident=True)
+
+
+def test_resident_config_knob_roundtrip():
+    """TOML `rns_resident` -> SimConfig -> dump_config round trip, with
+    the default on; the fp_backend validation error names the choices."""
+    from handel_tpu.sim.config import dump_config, load_config
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "cfg.toml")
+        with open(path, "w") as f:
+            f.write('fp_backend = "rns"\nrns_resident = false\n')
+        cfg = load_config(path)
+        assert cfg.rns_resident is False
+        assert "rns_resident = false" in dump_config(cfg)
+        with open(path, "w") as f:
+            f.write('fp_backend = "rns"\n')
+        cfg = load_config(path)
+        assert cfg.rns_resident is True
+        assert "rns_resident = true" in dump_config(cfg)
+        bad = os.path.join(d, "bad.toml")
+        with open(bad, "w") as f:
+            f.write('fp_backend = "vpu"\n')
+        with pytest.raises(ValueError, match="cios.*rns"):
+            load_config(bad)
+
+
+def test_resident_sharding_rule():
+    """Resident residue planes are batch-last like positional limb banks:
+    a `res_`-named operand shards its trailing axis with the registry."""
+    from handel_tpu.parallel.sharding import (
+        P,
+        launch_partition_rules,
+        match_partition_rules,
+    )
+
+    specs = match_partition_rules(
+        launch_partition_rules("dp"),
+        ["reg_x0", "res_f12_c0", "resident_acc", "mask", "sig_x"],
+    )
+    assert specs["res_f12_c0"] == P(None, "dp")
+    assert specs["resident_acc"] == P(None, "dp")
+    assert specs["reg_x0"] == P(None, "dp")
+    assert specs["mask"] == P("dp", None)
+    assert specs["sig_x"] == P()

@@ -18,8 +18,15 @@ rng = random.Random(7)
 B = 4
 
 
-@pytest.fixture(scope="module")
-def T():
+@pytest.fixture(scope="module", params=["cios", "rns"])
+def T(request):
+    """Both Field backends through the SAME oracle assertions. The rns
+    param runs the RESIDENT tower (ops/rns.py ResidentRns adapter +
+    Tower.as_resident): values stay residue planes across every tower op
+    and reconstruct through the CRT only at the unpack boundary — the
+    form the pairing rides (residue-resident pairing)."""
+    if request.param == "rns":
+        return Tower(Field(bn.P, backend="rns")).as_resident()
     return Tower(Field(bn.P, use_pallas=False))
 
 
@@ -45,7 +52,11 @@ def test_f2_mul_sqr_inv(T):
     ]
     assert T.f2_unpack(jax.jit(T.f2_sqr)(ax)) == [bn.f2_sqr(x) for x in xs]
     assert T.f2_unpack(jax.jit(T.f2_inv)(ax)) == [bn.f2_inv(x) for x in xs]
-    assert T.f2_unpack(jax.jit(T.f2_mul_xi)(ax)) == [bn.f2_mul_xi(x) for x in xs]
+    # blog=0: freshly packed operands are canonical (< p); the resident
+    # backend demands the literal, positional backends ignore it
+    assert T.f2_unpack(jax.jit(lambda a: T.f2_mul_xi(a, 0))(ax)) == [
+        bn.f2_mul_xi(x) for x in xs
+    ]
 
 
 def test_f2_mul_fp(T):
@@ -68,7 +79,7 @@ def test_f12_inv_conj(T):
     ax = T.f12_pack(xs)
     got = T.f12_unpack(jax.jit(T.f12_inv)(ax))
     assert got == [bn.f12_inv(x) for x in xs]
-    assert T.f12_unpack(T.f12_conj(ax)) == [bn.f12_conj(x) for x in xs]
+    assert T.f12_unpack(T.f12_conj(ax, 0)) == [bn.f12_conj(x) for x in xs]
 
 
 def test_f12_frobenius(T):
@@ -121,8 +132,11 @@ def test_f6_mul_v_and_select(T):
     got = T.f12_unpack(sel)
     assert got[0] == xs[0]
     assert got[1] == bn.F12_ONE
-    eq = T.f12_eq(ax, ax)
-    assert eq.tolist() == [True, True]
+    if not getattr(T.F, "is_resident", False):
+        # residue-plane equality is a boundary op: the resident adapter
+        # refuses F.eq by contract (compare after from_resident instead)
+        eq = T.f12_eq(ax, ax)
+        assert eq.tolist() == [True, True]
 
 
 def test_cyclotomic_square_matches_generic(T):
