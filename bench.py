@@ -727,6 +727,79 @@ def _fleet_metrics() -> dict:
         return {}
 
 
+def rlc_bench(batch: int = 64, messages: int = 4, trials: int = 5) -> dict:
+    """Random-linear-combination batch verification (models/rlc.py) vs the
+    per-candidate pairing loop, host math path: one launch of `batch`
+    candidates over `messages` distinct messages, checked both ways.
+
+    Both modes ride every bench line: `rlc_per_candidate_p50_ms` is the C
+    independent 2-pairing checks (the per_candidate device contract),
+    `rlc_verify_p50_ms` is the single combined check — two MSMs plus one
+    M+1-Miller-loop product pairing — and `rlc_speedup_x` is their ratio
+    (acceptance: >= 3x at batch 64). Aggregation cost is excluded from
+    both sides: the apk is built once up front, exactly what a device
+    launch stages, so the ratio isolates the pairing-tail change."""
+    rng = random.Random(4096)
+
+    from statistics import median
+
+    from handel_tpu.core.bitset import BitSet
+    from handel_tpu.models import rlc
+    from handel_tpu.models.bn254 import BN254Scheme
+
+    scheme = BN254Scheme()
+    n = 16
+    keys = [scheme.keygen(i) for i in range(n)]
+    pubs = [pk for _, pk in keys]
+    msgs = [f"rlc-bench-{m}".encode() for m in range(messages)]
+    cands = []
+    for j in range(batch):
+        msg = msgs[j % messages]
+        bs = BitSet(n)
+        sig = None
+        for i in rng.sample(range(n), rng.randrange(2, 6)):
+            bs.set(i)
+            s = keys[i][0].sign(msg)
+            sig = s if sig is None else sig.combine(s)
+        apk = scheme.constructor.aggregate_public_keys(pubs, bs)
+        cands.append((msg, apk.point, sig.point))
+
+    ops = rlc.host_ops_for(scheme.constructor)
+    pc_times, rlc_times = [], []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        for msg, x, s in cands:
+            assert ops.pairing_check(
+                [(ops.hash_to_g1(msg), x), (ops.g1_neg(s), ops.g2_gen)]
+            )
+        pc_times.append((time.perf_counter() - t0) * 1e3)
+        t0 = time.perf_counter()
+        assert rlc.host_rlc_check(ops, cands)
+        rlc_times.append((time.perf_counter() - t0) * 1e3)
+    pc_p50, rlc_p50 = median(pc_times), median(rlc_times)
+    return {
+        "rlc_verify_p50_ms": round(rlc_p50, 3),
+        "rlc_per_candidate_p50_ms": round(pc_p50, 3),
+        "rlc_speedup_x": round(pc_p50 / rlc_p50, 2),
+        "rlc_batch": batch,
+        "rlc_messages": messages,
+    }
+
+
+def _rlc_metrics() -> dict:
+    """rlc_bench behind the degrade-don't-die contract (+ a shape override
+    for tests: HANDEL_TPU_BENCH_RLC_SHAPE = 'batch,messages,trials')."""
+    shape = os.environ.get("HANDEL_TPU_BENCH_RLC_SHAPE")
+    try:
+        if shape:
+            batch, messages, trials = (int(x) for x in shape.split(","))
+            return rlc_bench(batch, messages, trials)
+        return rlc_bench()
+    except Exception as e:
+        print(f"bench: rlc bench failed: {e}", file=sys.stderr)
+        return {}
+
+
 def _host_metrics() -> dict:
     """host_pipeline_bench behind the bench's degrade-don't-die contract
     (+ a shape override for tests: HANDEL_TPU_BENCH_HOST_SHAPE =
@@ -1230,6 +1303,10 @@ def _measure() -> None:
         line.update(_small_batch_metrics())
         # vnode swarm: identities carried + bytes/identity + completion wall
         line.update(_swarm_metrics())
+        # RLC batch-check plane: both check modes on every line, keyed per
+        # fp_backend in bench_check (PER_FP_BACKEND) via the line's tag
+        line["fp_backend"] = curves.F.backend
+        line.update(_rlc_metrics())
 
         def persist(extra_line: dict) -> None:
             # provenance so a later tunnel outage can't erase the capture
@@ -1301,6 +1378,8 @@ def _measure() -> None:
         line.update(_fleet_metrics())
         line.update(_small_batch_metrics())
         line.update(_swarm_metrics())
+        line["fp_backend"] = curves.F.backend
+        line.update(_rlc_metrics())
         _emit(line)
 
 

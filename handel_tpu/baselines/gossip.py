@@ -77,6 +77,9 @@ class GossipAggregator:
         self.final: asyncio.Future = asyncio.get_event_loop().create_future()
         self._task: asyncio.Task | None = None
         self.sigs_checked = 0
+        # invalid origins evicted by the threshold-time bisection
+        # (aggregate-then-verify mode, _maybe_finish)
+        self.sigs_evicted = 0
         network.register_listener(self)
 
     # -- network in ---------------------------------------------------------
@@ -172,23 +175,51 @@ class GossipAggregator:
     def _maybe_finish(self) -> None:
         if self.final.done() or len(self.sigs) < self.threshold:
             return
+        if not self.verify_incoming:
+            # aggregate-then-verify mode: one combined check at threshold.
+            # On failure, bisect by origin (models/rlc.py bisect_verify —
+            # the binary search the reference leaves as a TODO at
+            # aggregator.go:206) and EVICT the culprits, so the poisoned
+            # subset is never re-verified wholesale on every later packet
+            # (the inherited double-count: sigs_checked grew by one full
+            # aggregate check per arrival while the set stayed poisoned).
+            from handel_tpu.models.rlc import bisect_verify
+
+            keys = [
+                self.reg.identity(i).public_key for i in range(self.reg.size())
+            ]
+
+            def check(origins) -> bool:
+                self.sigs_checked += 1
+                b = BitSet(self.reg.size())
+                a = None
+                for o in origins:
+                    b.set(o, True)
+                    s = self.sigs[o]
+                    a = s if a is None else a.combine(s)
+                return bool(
+                    self.cons.aggregate_public_keys(keys, b).verify(
+                        self.msg, a
+                    )
+                )
+
+            verdicts = bisect_verify(
+                list(self.sigs), check, lambda o: check([o])
+            )
+            bad = [o for o, ok in verdicts.items() if not ok]
+            for o in bad:
+                del self.sigs[o]
+                self.sigs_evicted += 1
+            if bad and len(self.sigs) < self.threshold:
+                return  # keep gossiping with the clean partial set
+        # every surviving origin passed a combined or per-origin check (or
+        # verify_incoming already vetted it at arrival)
         bs = BitSet(self.reg.size())
         agg = None
         for origin, sig in self.sigs.items():
             bs.set(origin, True)
             agg = sig if agg is None else agg.combine(sig)
         ms = MultiSignature(bs, agg)
-        if not self.verify_incoming:
-            # aggregate-then-verify mode: one check at threshold
-            keys = [
-                self.reg.identity(i).public_key for i in range(self.reg.size())
-            ]
-            self.sigs_checked += 1
-            if not self.cons.aggregate_public_keys(keys, bs).verify(
-                self.msg, agg
-            ):
-                return  # poisoned set; keep gossiping (binary search is the
-                # reference's TODO at aggregator.go:206 — same behavior)
         if self.rec is not None:
             self.rec.instant(
                 "threshold_reached",
@@ -267,6 +298,7 @@ class GossipAggregator:
         return {
             "sigsKnown": float(len(self.sigs)),
             "sigCheckedCt": float(self.sigs_checked),
+            "sigEvictedCt": float(self.sigs_evicted),
         }
 
 

@@ -49,6 +49,8 @@ class BLS12381JaxConstructor(BLS12381Constructor, BN254JaxConstructor):
         warmup: bool = True,
         fp_backend: str | None = None,
         rns_resident: bool | None = None,
+        batch_check: str = "per_candidate",
+        rlc_rng=None,
     ):
         BN254JaxConstructor.__init__(
             self,
@@ -58,6 +60,8 @@ class BLS12381JaxConstructor(BLS12381Constructor, BN254JaxConstructor):
             warmup=warmup,
             fp_backend=fp_backend,
             rns_resident=rns_resident,
+            batch_check=batch_check,
+            rlc_rng=rlc_rng,
         )
 
 
@@ -72,6 +76,7 @@ class BLS12381JaxScheme(BLS12381Scheme):
         warmup: bool = True,
         fp_backend: str | None = None,
         rns_resident: bool | None = None,
+        batch_check: str = "per_candidate",
     ):
         self.constructor = BLS12381JaxConstructor(
             batch_size=batch_size,
@@ -79,4 +84,5 @@ class BLS12381JaxScheme(BLS12381Scheme):
             warmup=warmup,
             fp_backend=fp_backend,
             rns_resident=rns_resident,
+            batch_check=batch_check,
         )

@@ -22,6 +22,7 @@ across calls.
 from __future__ import annotations
 
 import asyncio
+import random
 import time
 from collections import namedtuple
 from functools import partial
@@ -33,6 +34,7 @@ import numpy as np
 
 from handel_tpu.core.bitset import BitSet
 from handel_tpu.core.logging import DEFAULT_LOGGER
+from handel_tpu.models import rlc
 from handel_tpu.models.bn254 import (
     BN254Constructor,
     BN254PublicKey,
@@ -128,7 +130,19 @@ class BN254Device:
         mesh_devices: int = 1,
         jax_device=None,
         rns_resident: bool | None = None,
+        batch_check: str = "per_candidate",
+        rlc_rng: random.Random | None = None,
     ):
+        # batch_check selects the launch contract: "per_candidate" = one
+        # pairing-check lane pair per candidate (2C Miller loops, C final
+        # exps); "rlc" = the random-linear-combination combined check
+        # (models/rlc.py — M+1 Miller loops, 1 final exp, two MSMs) with
+        # bisection fallback down to the per-candidate oracle on failure
+        self.batch_check = rlc.validate_batch_check(batch_check)
+        # adversary-facing randomness: SystemRandom unless a test injects
+        # a seeded stream for reproducible bisection traces
+        self._rlc_rng = rlc_rng or random.SystemRandom()
+        self.rlc_stats = rlc.RlcStats()
         self.curves = curves or self.Curves()
         # rns_resident toggles the residue-resident pairing form
         # (ops/pairing.py): None = auto (on exactly for the 'rns' field
@@ -246,6 +260,12 @@ class BN254Device:
         self._donate = donate
         self._range_kernels: dict[int, callable] = {}
         self._combine_kernels: dict[int, callable] = {}
+        # RLC launch-class kernels: the MSM/aggregation stage keyed by
+        # (kind, miss_k, n_groups) and the G+1-lane pairing tail keyed by
+        # n_groups (n_groups quantized to powers of two, same reasoning as
+        # the miss_k classes: each tail variant is a pairing-graph compile)
+        self._rlc_msm_kernels: dict[tuple, callable] = {}
+        self._rlc_check_kernels: dict[int, callable] = {}
         # rotated zero-copy staging (double-buffered by default): bitset
         # uint64 words land directly in these pinned arrays, which are the
         # device-transfer source — ONE explicit jax.device_put per array in
@@ -593,6 +613,245 @@ class BN254Device:
             self._range_kernels[miss_k] = fn
         return fn
 
+    # -- RLC combined-check launch class (models/rlc.py) --------------------
+
+    # MSM digit width: 64-bit scalars run in 16 windowed steps of 15
+    # buckets each (ops/curve.py Curve.msm)
+    RLC_WINDOW = 4
+
+    def _rlc_msm_tail(self, agg, sig_x, sig_y, r_bits, group_oh, valid):
+        """Shared MSM stage: per-candidate aggregates (projective G2, batch
+        C) + signature lanes -> (S, X_g) in affine.
+
+        S = sum_j r_j·sig_j is a G1 MSM over the C signature lanes (C
+        blocks of batch 1); X_g = sum_{j in group g} r_j·apk_j tiles each
+        candidate across the G group lanes (index j*G + g) with the scalar
+        bits gated by the group one-hot, so one G2 MSM computes every
+        message group at once. Scalars are masked to the launch hull by
+        zeroing invalid lanes' bit columns — those lanes contribute the
+        identity. The affine epilogue converts each output batch in one
+        stacked-inversion `to_affine` call."""
+        C = self.batch_size
+        g1, g2 = self.curves.g1, self.curves.g2
+        G = group_oh.shape[0]
+        rb = r_bits * valid[None, :].astype(r_bits.dtype)
+        S = g1.msm(g1.from_affine(sig_x, sig_y), rb, C, window=self.RLC_WINDOW)
+        tree = jax.tree_util.tree_map
+        tiled = tree(
+            lambda a: jnp.broadcast_to(
+                a.reshape(a.shape[:-1] + (C, 1)), a.shape[:-1] + (C, G)
+            ).reshape(a.shape[:-1] + (C * G,)),
+            agg,
+        )
+        rb2 = (rb[:, :, None] * group_oh.T[None, :, :].astype(rb.dtype)).reshape(
+            rb.shape[0], C * G
+        )
+        X = g2.msm(tiled, rb2, C, window=self.RLC_WINDOW)
+        sx, sy, s_inf = g1.to_affine(S)
+        xx, xy, x_inf = g2.to_affine(X)
+        return sx, sy, s_inf, xx, xy, x_inf
+
+    def _rlc_msm_range(
+        self, lo, hi, miss_idx, miss_ok, sig_x, sig_y, r_bits, group_oh,
+        valid, prefix, reg_x, reg_y, miss_k,
+    ):
+        agg = self._range_aggregate(
+            lo, hi, miss_idx, miss_ok, prefix, reg_x, reg_y, miss_k
+        )
+        return self._rlc_msm_tail(agg, sig_x, sig_y, r_bits, group_oh, valid)
+
+    def _rlc_msm_dense(
+        self, words32, sig_x, sig_y, r_bits, group_oh, valid, reg_x, reg_y
+    ):
+        C = self.batch_size
+        g2 = self.curves.g2
+        mask = self._unpack_words(words32, valid)
+        tile = lambda a: jnp.repeat(a, C, axis=1)
+        P2 = g2.from_affine(
+            (tile(reg_x[0]), tile(reg_x[1])), (tile(reg_y[0]), tile(reg_y[1]))
+        )
+        agg = g2.masked_sum(P2, mask, self.n)
+        return self._rlc_msm_tail(agg, sig_x, sig_y, r_bits, group_oh, valid)
+
+    def _rlc_check(self, sx, sy, s_inf, xx, xy, x_inf, h_gx, h_gy, g_occ):
+        """(G+1)-lane product-of-pairings with ONE shared final exponentiation:
+        lanes 0..G-1 carry e(H(m_g), X_g), lane G carries e(-S, B2). Masked
+        lanes contribute 1 — which IS the factor an infinity operand would
+        contribute (e(·, O) = e(O, ·) = 1), so infinity and padding lanes
+        mask out without changing the product. Returns the (1,) verdict."""
+        T, F = self.curves.T, self.curves.F
+        b2x = T.f2_pack([self.ref.G2_GEN[0]])
+        b2y = T.f2_pack([self.ref.G2_GEN[1]])
+        px = jnp.concatenate([h_gx, sx], axis=1)
+        py = jnp.concatenate([h_gy, F.neg(sy)], axis=1)
+        qx = (
+            jnp.concatenate([xx[0], b2x[0]], axis=1),
+            jnp.concatenate([xx[1], b2x[1]], axis=1),
+        )
+        qy = (
+            jnp.concatenate([xy[0], b2y[0]], axis=1),
+            jnp.concatenate([xy[1], b2y[1]], axis=1),
+        )
+        lane_mask = jnp.concatenate([g_occ & ~x_inf, ~s_inf])
+        return self.pairing.pairing_check((px, py), (qx, qy), lane_mask, 1)
+
+    def _rlc_msm_kernel(self, kind: str, miss_k: int, G: int):
+        """MSM/aggregation stage as its own executable per launch class —
+        point adds only, no pairing, so it compiles in seconds and can be
+        profiled (or host-checked, scripts/rlc_smoke.py) standalone. Same
+        bank-injection wrapper as `_range_agg_kernel`: epoch flips reach
+        compiled kernels. G rides in the key for the class bookkeeping;
+        the executable itself specializes on the group_oh shape."""
+        key = (kind, miss_k, G)
+        fn = self._rlc_msm_kernels.get(key)
+        if fn is None:
+            if kind == "range":
+                _ = self._prefix
+                jitted = jax.jit(
+                    partial(self._rlc_msm_range, miss_k=miss_k),
+                    # per-launch staging + scalar operands donate; the bank
+                    # args (9, 10, 11) are device residents
+                    donate_argnums=tuple(range(9)) if self._donate else (),
+                )
+
+                def fn(
+                    lo, hi, miss_idx, miss_ok, sig_x, sig_y, r_bits,
+                    group_oh, valid, _jitted=jitted,
+                ):
+                    return _jitted(
+                        lo, hi, miss_idx, miss_ok, sig_x, sig_y, r_bits,
+                        group_oh, valid,
+                        self._prefix, self._reg_x, self._reg_y,
+                    )
+
+            else:
+                jitted = jax.jit(
+                    self._rlc_msm_dense,
+                    donate_argnums=tuple(range(6)) if self._donate else (),
+                )
+
+                def fn(
+                    words32, sig_x, sig_y, r_bits, group_oh, valid,
+                    _jitted=jitted,
+                ):
+                    return _jitted(
+                        words32, sig_x, sig_y, r_bits, group_oh, valid,
+                        self._reg_x, self._reg_y,
+                    )
+
+            self._rlc_msm_kernels[key] = fn
+        return fn
+
+    def _rlc_check_kernel(self, G: int):
+        fn = self._rlc_check_kernels.get(G)
+        if fn is None:
+            fn = jax.jit(self._rlc_check)
+            self._rlc_check_kernels[G] = fn
+        return fn
+
+    def _rlc_combined_launch(self, items, sub):
+        """One combined RLC check over candidate indices `sub` of `items`
+        ((msg, bitset, sig) triples, pre-screened valid): fresh 64-bit
+        scalars, message-grouped G2 MSM (n_groups quantized to the next
+        power of two), (G+1)-lane pairing tail. Returns the (1,) device
+        verdict — async like every dispatch; staging reuse and fencing
+        follow the ordinary launch contract."""
+        t0 = time.perf_counter()
+        C = self.batch_size
+        plan = self._pack_requests([(items[j][1], items[j][2]) for j in sub])
+        msgs = [items[j][0] for j in sub]
+        uniq: dict[bytes, int] = {}
+        gid = [uniq.setdefault(m, len(uniq)) for m in msgs]
+        M = len(uniq)
+        G = 1
+        while G < M:
+            G *= 2
+        rs = rlc.draw_scalars(len(sub), self._rlc_rng)
+        r_bits = np.zeros((rlc.SCALAR_BITS, C), np.uint32)
+        r_bits[:, : len(sub)] = np.asarray(self.curves.scalar_bits64(rs))
+        group_oh = np.zeros((G, C), bool)
+        group_oh[gid, np.arange(len(sub))] = True
+        g_occ = np.arange(G) < M
+        # per-group H(m) columns; padded groups repeat the last real column
+        # (masked out by g_occ, any finite h keeps the math well-defined)
+        order = [None] * M
+        for m, g in uniq.items():
+            order[g] = m
+        cols = [self._h_cols(m) for m in order]
+        hx = np.concatenate([c[0] for c in cols] + [cols[-1][0]] * (G - M), axis=1)
+        hy = np.concatenate([c[1] for c in cols] + [cols[-1][1]] * (G - M), axis=1)
+        t1 = time.perf_counter()
+        self.host_pack_ms += (t1 - t0) * 1000.0
+        self.host_pack_launches += 1
+        dp = self._dput
+        staged = self._stage_plan(plan)
+        if plan.kind == "range":
+            lo, hi, mi, mo, sig_x, sig_y, valid = staged
+            outs = self._rlc_msm_kernel("range", plan.miss_k, G)(
+                lo, hi, mi, mo, sig_x, sig_y,
+                dp(r_bits), dp(group_oh), valid,
+            )
+        else:
+            words32, sig_x, sig_y, valid = staged
+            outs = self._rlc_msm_kernel("dense", 0, G)(
+                words32, sig_x, sig_y, dp(r_bits), dp(group_oh), valid
+            )
+        verdict = self._rlc_check_kernel(G)(*outs, dp(hx), dp(hy), dp(g_occ))
+        self._stage[self._stage_idx].fence = verdict
+        self.rlc_stats.miller_lanes += G + 1
+        self.rlc_stats.final_exp_lanes += 1
+        if M > 1:
+            self.multi_msg_launches += 1
+        self.host_dispatch_ms += (time.perf_counter() - t1) * 1000.0
+        self.host_dispatch_launches += 1
+        return verdict
+
+    def _dispatch_rlc(self, items):
+        """RLC-mode dispatch: pre-screen validity host-side (the same
+        criterion the packer applies), launch the combined check over the
+        valid lanes now (async), and defer verdict resolution — including
+        any bisection relaunches — to `fetch`."""
+        k = len(items)
+        valid_j = [
+            j
+            for j, (_m, bs, sig) in enumerate(items)
+            if bs.cardinality() > 0 and getattr(sig, "point", None) is not None
+        ]
+        vdev = (
+            self._rlc_combined_launch(items, valid_j)
+            if len(valid_j) > 1
+            else None
+        )
+        return ("rlc", items, valid_j, vdev, k)
+
+    def _fetch_rlc(self, handle):
+        """Resolve an RLC handle: a passing combined check accepts every
+        valid lane; a failing one bisects with fresh scalars down to the
+        per-candidate oracle (`_dispatch_one` on the single candidate), so
+        culprits are isolated and attributed exactly as per_candidate mode
+        would. Invalid lanes are False without any device work."""
+        _, items, valid_j, vdev, k = handle
+        verdicts = [False] * k
+        top = [vdev]
+
+        def combined(sub):
+            v = top[0]
+            top[0] = None
+            if v is None or len(sub) != len(valid_j):
+                v = self._rlc_combined_launch(items, sub)
+            return bool(np.asarray(v)[0])
+
+        def oracle(j):
+            msg, bs, sig = items[j]
+            v = self._dispatch_one(msg, [(bs, sig)])
+            return bool(np.asarray(v)[0])
+
+        for j, ok in rlc.bisect_verify(
+            valid_j, combined, oracle, self.rlc_stats
+        ).items():
+            verdicts[j] = ok
+        return verdicts
+
     # -- host entry points --------------------------------------------------
 
     def _h_point(self, msg: bytes):
@@ -646,11 +905,16 @@ class BN254Device:
         the verdicts. On the mesh path the staged pipeline's host glue
         (`_sharded_tail`) completes the launch before returning — there
         `fetch` is effectively a no-op and launch wall time lands on the
-        dispatch side of the monitor plane."""
+        dispatch side of the monitor plane. In RLC mode the handle carries
+        the in-flight combined check; bisection (if any) runs at fetch."""
+        if self.batch_check == "rlc":
+            return self._dispatch_rlc([(msg, bs, sig) for bs, sig in requests])
         return (self._dispatch_one(msg, requests), len(requests))
 
     def fetch(self, handle) -> list[bool]:
         """Block until a dispatched launch's verdicts arrive; host-ordered."""
+        if len(handle) == 5 and handle[0] == "rlc":
+            return self._fetch_rlc(handle)
         verdicts, k = handle
         return [bool(v) for v in np.asarray(verdicts)[:k]]
 
@@ -759,8 +1023,25 @@ class BN254Device:
             bs = BitSet(self.n)
             for i in signers:
                 bs.set(i, True)
+            # in RLC mode a single-candidate dispatch resolves through the
+            # per-candidate oracle at fetch, so this loop compiles the
+            # per-candidate kernel classes (the bisection floor) either way
             self.fetch(self.dispatch(b"bn254-device-warmup", [(bs, sig)]))
             launches += 1
+        if self.batch_check == "rlc":
+            # compile the RLC combined-check classes (MSM stage + (G+1)-lane
+            # pairing tail) with a two-candidate launch per plan class. The
+            # warmup sig is not a valid signature, so each combined check
+            # FAILS and the bisection path — fresh-scalar singleton oracles
+            # — runs too: exactly the kernels a forged batch needs hot.
+            for signers in shapes:
+                bs = BitSet(self.n)
+                for i in signers:
+                    bs.set(i, True)
+                self.fetch(
+                    self.dispatch(b"bn254-device-warmup", [(bs, sig)] * 2)
+                )
+                launches += 1
         if multi_msg and self.n >= 2:
             bs1, bs2 = BitSet(self.n), BitSet(self.n)
             bs1.set(0, True)
@@ -794,6 +1075,7 @@ class BN254Device:
         self.host_pack_launches = 0
         self.host_dispatch_ms = 0.0
         self.host_dispatch_launches = 0
+        self.rlc_stats = rlc.RlcStats()
 
     # missing-signer patch width cap: candidates whose range hull has more
     # holes than this fall back to the dense masked-sum kernel
@@ -1050,6 +1332,11 @@ class BN254Device:
         # Handel candidates are partitioner ID ranges with few holes: the
         # prefix-table fast path; the dense kernel is the arbitrary-set
         # fallback (plan.kind decides, same classes as always)
+        # per-candidate pairing-work accounting (the 2C / C baseline the
+        # RLC smoke compares against): every plan run pays a 2C-lane
+        # Miller batch and a C-lane final exponentiation
+        self.rlc_stats.miller_lanes += 2 * self.batch_size
+        self.rlc_stats.final_exp_lanes += self.batch_size
         if self.mesh is not None:
             # whole-mesh (latency-plane) launch accounting: the mesh lane's
             # telemetry row (parallel/telemetry.py) reads these
@@ -1172,7 +1459,14 @@ class BN254Device:
         is the key universe for every lane. A uniform-message batch
         delegates to the ordinary `dispatch` (cached (L, 1) h, no extra
         kernel variant); mixed messages stage per-lane (L, C) h columns
-        into the same kernels. Returns a `fetch`-compatible handle."""
+        into the same kernels. Returns a `fetch`-compatible handle.
+
+        In RLC mode mixed messages GROUP rather than widen: the combined
+        check groups lanes by message for M+1 Miller loops total, so a
+        multi-tenant coalesced launch costs one Miller loop per distinct
+        message plus one — not two per candidate."""
+        if self.batch_check == "rlc":
+            return self._dispatch_rlc([(it[0], it[2], it[3]) for it in items])
         msgs = [it[0] for it in items]
         reqs = [(it[2], it[3]) for it in items]
         if len(set(msgs)) <= 1:
@@ -1225,11 +1519,15 @@ class BN254JaxConstructor(BN254Constructor):
         breaker: CircuitBreaker | None = None,
         fp_backend: str | None = None,
         rns_resident: bool | None = None,
+        batch_check: str = "per_candidate",
+        rlc_rng: random.Random | None = None,
     ):
         self.batch_size = batch_size
         self.mesh_devices = mesh_devices
         self.fp_backend = fp_backend
         self.rns_resident = rns_resident
+        self.batch_check = rlc.validate_batch_check(batch_check)
+        self._rlc_rng = rlc_rng
         # fp_backend picks the Field modmul kernel (ops/fp.py backend seam:
         # "cios"/"rns"); an explicit `curves` wins, carrying its own Field
         self.curves = curves or self.Device.Curves(backend=fp_backend)
@@ -1249,6 +1547,8 @@ class BN254JaxConstructor(BN254Constructor):
             curves=self.curves,
             mesh_devices=self.mesh_devices,
             rns_resident=self.rns_resident,
+            batch_check=self.batch_check,
+            rlc_rng=self._rlc_rng,
         )
         if self.warmup:
             # compile all reachable kernels NOW, at scheme construction, so
@@ -1326,6 +1626,7 @@ class BN254JaxScheme(BN254Scheme):
         warmup: bool = True,
         fp_backend: str | None = None,
         rns_resident: bool | None = None,
+        batch_check: str = "per_candidate",
     ):
         self.constructor = BN254JaxConstructor(
             batch_size=batch_size,
@@ -1333,6 +1634,7 @@ class BN254JaxScheme(BN254Scheme):
             warmup=warmup,
             fp_backend=fp_backend,
             rns_resident=rns_resident,
+            batch_check=batch_check,
         )
 
 

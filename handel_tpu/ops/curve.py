@@ -277,6 +277,75 @@ class Curve:
         P = self.select(mask, P, self.infinity(self.ops.batch(P[0])))
         return self.sum_points(P, n)
 
+    def msm(self, P, bits, n: int, window: int = 4):
+        """Batched multi-scalar multiplication: out lane j = sum_i k[i,j]·P[i,j]
+        over n point blocks laid out block-major along the batch axis (block i
+        lane j = batch index i*b + j, like `sum_points`). bits: (nbits, n*b)
+        uint32 MSB-first per-lane scalar bits (`BN254Curves.scalar_bits` /
+        `scalar_bits64` shape). Lanes whose scalar is 0 contribute the
+        identity, so masking to the launch hull is just zeroing those
+        columns before the call.
+
+        Windowed/bucketed accumulation shaped for the existing reduction
+        kernels rather than a per-point double-and-add: the scalar stream is
+        cut into w-bit digits; each window step sorts blocks into the
+        V = 2^w - 1 nonzero buckets with ONE `masked_sum` over a (n, V, b)
+        tiling (the bucket histogram is a select mask, not a gather), turns
+        bucket sums into Σ v·B_v with a Hillis-Steele *suffix* scan over the
+        bucket axis (Σ_v v·B_v = Σ_v Σ_{u≥v} B_u — log2 V adds, no scalar
+        mul), and Horner-folds windows under `lax.scan` (w doublings + one
+        add per step), so compile cost is independent of nbits. window=1
+        degenerates to a shared double-and-add over `masked_sum`.
+
+        Cost per window step: w doubles + [log2 n + 2·log2 V + 1] complete
+        adds, all stacked full-width — 64-bit scalars at w=4 are 16 steps."""
+        o = self.ops
+        tree = jax.tree_util.tree_map
+        nb = o.batch(P[0])
+        b = nb // n
+        V = (1 << window) - 1
+        nbits = bits.shape[0]
+        pad = (-nbits) % window
+        if pad:
+            bits = jnp.concatenate([jnp.zeros((pad, nb), bits.dtype), bits])
+        nwin = (nbits + pad) // window
+        weights = (1 << jnp.arange(window - 1, -1, -1, dtype=jnp.uint32))
+        digits = (bits.reshape(nwin, window, nb) * weights[None, :, None]).sum(
+            axis=1, dtype=jnp.int32
+        )  # (nwin, n*b), each in [0, 2^w)
+
+        # Tile each block across the V buckets: (..., n*b) -> (..., n*V*b),
+        # tiled index i*V*b + v*b + j <- block i lane j. Loop-invariant.
+        tiled = tree(
+            lambda a: jnp.broadcast_to(
+                a.reshape(a.shape[:-1] + (n, 1, b)), a.shape[:-1] + (n, V, b)
+            ).reshape(a.shape[:-1] + (n * V * b,)),
+            P,
+        )
+        bucket_of = jnp.arange(V * b) // b  # suffix-scan block ids
+
+        def step(acc, d):
+            for _ in range(window):
+                acc = self.double(acc)
+            # bucket membership: tiled lane (i, v, j) set iff digit == v+1
+            hit = d[None, :] == jnp.arange(1, V + 1, dtype=jnp.int32)[:, None]
+            mask = hit.reshape(V, n, b).transpose(1, 0, 2).reshape(n * V * b)
+            buckets = self.masked_sum(tiled, mask, n)  # (V, b) bucket-major
+            d2 = 1
+            while d2 < V:  # suffix sums R_v = sum_{u >= v} B_u
+                keep = bucket_of + d2 < V
+                shifted = self.select(
+                    keep,
+                    tree(lambda a: jnp.roll(a, -d2 * b, axis=-1), buckets),
+                    self.infinity(V * b),
+                )
+                buckets = self.add(buckets, shifted)
+                d2 *= 2
+            return self.add(acc, self.sum_points(buckets, V)), None
+
+        acc, _ = jax.lax.scan(step, self.infinity(b), digits)
+        return acc
+
     def prefix_scan(self, P):
         """Inclusive prefix sums along the batch axis: out lane i = sum of
         lanes 0..i. Hillis-Steele doubling scan over the complete add: every
@@ -394,14 +463,33 @@ class BN254Curves:
 
     @staticmethod
     def scalar_bits(ks, nbits: int = 256):
-        """Host: list of ints -> (nbits, len(ks)) uint32 MSB-first bit array."""
+        """Host: list of ints -> (nbits, len(ks)) uint32 MSB-first bit array.
+        Vectorized over 32-bit words so packing C scalars per launch is numpy
+        work, not a python bit loop."""
         import numpy as np
 
-        out = np.zeros((nbits, len(ks)), np.uint32)
-        for j, k in enumerate(ks):
-            for i in range(nbits):
-                out[nbits - 1 - i, j] = (k >> i) & 1
-        return jnp.asarray(out)
+        nwords = (nbits + 31) // 32
+        words = np.empty((nwords, len(ks)), np.uint32)
+        for w in range(nwords):
+            words[w] = [(k >> (32 * w)) & 0xFFFFFFFF for k in ks]
+        shifts = np.arange(31, -1, -1, dtype=np.uint32)
+        bits = (words[:, None, :] >> shifts[None, :, None]) & np.uint32(1)
+        # word w covers bit rows [nbits-32(w+1), nbits-32w): stack words
+        # high-to-low (bit order within each word is already MSB-first),
+        # then trim any rows above nbits
+        bits = bits[::-1].reshape(nwords * 32, len(ks))
+        bits = bits[nwords * 32 - nbits :]
+        return jnp.asarray(np.ascontiguousarray(bits))
+
+    @staticmethod
+    def scalar_bits64(ks):
+        """Host: 64-bit scalars -> (64, len(ks)) uint32 MSB-first — the RLC
+        launch's per-candidate random-coefficient operand."""
+        import numpy as np
+
+        a = np.asarray(ks, dtype=np.uint64)
+        shifts = np.arange(63, -1, -1, dtype=np.uint64)
+        return jnp.asarray(((a[None, :] >> shifts[:, None]) & np.uint64(1)).astype(np.uint32))
 
 
 class BLS12Curves(BN254Curves):

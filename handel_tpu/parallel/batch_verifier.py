@@ -1044,6 +1044,8 @@ class BatchVerifierService:
             "devicesAvailable",
             "meshLanes",
             "meshLanesAvailable",
+            "checkMode",
+            "bisectionDepthMax",
             "epoch",
             "lastQuiesceStallMs",
             "shedRate",

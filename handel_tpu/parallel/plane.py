@@ -107,10 +107,22 @@ class DeviceLane:
 
     def values(self) -> dict[str, float]:
         """One `device`-labeled metrics row."""
+        st = getattr(self.engine, "rlc_stats", None)
         return {
             # scheduling mode of this row: 1 = whole-mesh latency lane,
             # 0 = per-chip throughput lane (`sim watch` mode column)
             "mode": 1.0 if self.mesh else 0.0,
+            # batch-check mode of the engine (models/rlc.py): 1 = rlc
+            # combined check, 0 = per-candidate (`sim watch` check column)
+            "checkMode": (
+                1.0 if getattr(self.engine, "batch_check", "per_candidate")
+                == "rlc" else 0.0
+            ),
+            # RLC plane: top-level combined checks, post-failure bisection
+            # rechecks, deepest recheck level this engine ever reached
+            "rlcLaunches": float(st.rlc_launches) if st else 0.0,
+            "bisectionCt": float(st.bisection_ct) if st else 0.0,
+            "bisectionDepthMax": float(st.bisection_depth_max) if st else 0.0,
             "launches": float(self.launches),
             "candidates": float(self.candidates),
             "fillRatio": (
@@ -276,7 +288,25 @@ class DevicePlane:
     def values(self) -> dict[str, float]:
         """Fleet aggregates (folded into the service's values())."""
         mesh = self.mesh_lanes()
+        stats = [
+            st for l in self.lanes
+            if (st := getattr(l.engine, "rlc_stats", None)) is not None
+        ]
         return {
+            # RLC batch-check plane (models/rlc.py): counters SUM over the
+            # fleet, the depth high-water mark is a MAX (a per-engine
+            # maximum summed across lanes would mean nothing)
+            "rlcLaunches": float(sum(s.rlc_launches for s in stats)),
+            "bisectionCt": float(sum(s.bisection_ct for s in stats)),
+            "bisectionDepthMax": float(max(
+                (s.bisection_depth_max for s in stats), default=0
+            )),
+            "checkMode": (
+                1.0 if any(
+                    getattr(l.engine, "batch_check", "per_candidate") == "rlc"
+                    for l in self.lanes
+                ) else 0.0
+            ),
             "devicesTotal": float(len(self.lanes)),
             "devicesAvailable": float(len(self.allowed())),
             "schedPicks": float(self.sched_picks),
@@ -306,13 +336,15 @@ class DevicePlane:
 
 
 def host_plane(constructor, devices: int, batch_size: int = 64,
-               launch_ms: float = 0.0) -> DevicePlane:
+               launch_ms: float = 0.0,
+               batch_check: str = "per_candidate") -> DevicePlane:
     """A plane of K host-math engines (service/driver.py HostDevice) — the
     CI/bench shape: real scheduling + breakers, no kernels compiled."""
     from handel_tpu.service.driver import HostDevice
 
     return DevicePlane([
-        HostDevice(constructor, batch_size=batch_size, launch_ms=launch_ms)
+        HostDevice(constructor, batch_size=batch_size, launch_ms=launch_ms,
+                   batch_check=batch_check)
         for _ in range(max(1, devices))
     ])
 

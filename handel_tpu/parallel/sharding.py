@@ -81,11 +81,21 @@ def launch_partition_rules(axis: str = "dp"):
     Resident residue planes (ops/rns.py `to_resident`) are (k_all, B)
     like positional limb arrays — batch-last — so any operand spelled
     `res_*` / `resident_*` shards its trailing batch axis the same way
-    the registry banks do."""
+    the registry banks do.
+
+    The RLC launch class (models/bn254_jax.py `_rlc_combined_launch`)
+    adds three per-candidate operands — the random-coefficient bit plane
+    `r_bits` (nbits, C), the message-group one-hot `group_oh` (G, C) and
+    the group-occupancy mask `g_occ` (G,) — all candidate-axis-last and
+    REPLICATED, named explicitly (not left to the catch-all) because the
+    `mask`-style row rule must never capture them: sharding the scalar
+    plane would split one candidate's bit column across chips and the
+    MSM's bucket masks with it."""
     return (
         (r"^(reg|prefix)", P(None, axis)),
         (r"^res(ident)?_", P(None, axis)),
         (r"^mask$", P(axis, None)),
+        (r"^(r_bits|group_oh|g_occ)$", P()),
         (r"", P()),
     )
 

@@ -156,6 +156,9 @@ class ServiceParams:
     period_ms: float = 10.0  # gossip period of the session nodes
     fp_backend: str = ""  # Field modmul kernel for the service's verify
     # plane ("cios"/"rns", ops/fp.py backend seam); "" -> global fp_backend
+    batch_check: str = "per_candidate"  # verifier check mode: "per_candidate"
+    # (one pairing check per lane) or "rlc" (random-linear-combination
+    # combined check with bisection fallback, models/rlc.py)
 
     def enabled(self) -> bool:
         return self.sessions > 0
@@ -424,6 +427,7 @@ def load_config(path: str) -> SimConfig:
         spawn_stagger_ms=float(sv.get("spawn_stagger_ms", 0.0)),
         period_ms=float(sv.get("period_ms", 10.0)),
         fp_backend=str(sv.get("fp_backend", "")),
+        batch_check=str(sv.get("batch_check", "per_candidate")),
     )
     if cfg.fp_backend not in ("cios", "rns") or cfg.service.fp_backend not in (
         "", "cios", "rns",
@@ -433,6 +437,11 @@ def load_config(path: str) -> SimConfig:
             f"{cfg.fp_backend!r} / service {cfg.service.fp_backend!r} "
             "(the 'rns' backend additionally honours the boolean "
             "`rns_resident` knob for residue-resident pairing)"
+        )
+    if cfg.service.batch_check not in ("per_candidate", "rlc"):
+        raise ValueError(
+            "service.batch_check must be one of 'per_candidate', 'rlc', got "
+            f"{cfg.service.batch_check!r}"
         )
     so = raw.get("soak", {})
     cfg.soak = SoakParams(
@@ -581,6 +590,7 @@ def dump_config(cfg: SimConfig) -> str:
             f"spawn_stagger_ms = {cfg.service.spawn_stagger_ms}",
             f"period_ms = {cfg.service.period_ms}",
             f'fp_backend = "{cfg.service.fp_backend}"',
+            f'batch_check = "{cfg.service.batch_check}"',
         ]
     if cfg.soak != SoakParams():  # non-default soak shapes round-trip
         lines += [

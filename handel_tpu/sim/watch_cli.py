@@ -157,6 +157,9 @@ def aggregate(scrapes: list[dict]) -> dict:
         # dual-mode scheduling (parallel/mesh_plane.py): 1 = whole-mesh
         # latency lane, 0 = per-chip throughput lane
         ("mode", "handel_device_verifier_mode"),
+        # batch-check mode (models/rlc.py): 1 = rlc, 0 = per-candidate
+        ("check", "handel_device_verifier_check_mode"),
+        ("bisections", "handel_device_verifier_bisection_ct"),
     ):
         for labels, v in _samples(fams, name):
             did = labels.get("device")
@@ -312,14 +315,21 @@ def render_devices(model: dict) -> list[str]:
         fill = row.get("fill")
         breaker = _BREAKER_NAMES.get(row.get("breaker", 0.0), "?")
         mode = "mesh" if row.get("mode", 0.0) >= 1.0 else "lane"
+        # batch-check mode column (models/rlc.py): rlc lanes also show
+        # their bisection recheck count beside the verdict launches
+        check = "rlc" if row.get("check", 0.0) >= 1.0 else "percand"
+        bis = ""
+        if check == "rlc":
+            bis = f"  bisect {int(row.get('bisections', 0)):>4}"
         lines.append(
             f"  dev {did:>3} mode {mode}"
+            f"  check {check:<7}"
             f"  launches {int(row.get('launches', 0)):>6}"
             f"  inflight {int(row.get('inflight', 0)):>2}"
             f"  load {int(row.get('load', 0)):>2}"
             f"  fill {('--' if fill is None else f'{fill:.2f}')}"
             f"  retries {int(row.get('retries', 0)):>3}"
-            f"  breaker {breaker}"
+            f"  breaker {breaker}{bis}"
         )
     if mesh_rows:
         fills = [r["fill"] for r in mesh_rows if r.get("fill") is not None]

@@ -94,6 +94,12 @@ SIDE_METRICS = {
     # boundaries); legacy: once per tower mul)
     "pairing_p50_ms": "lower",
     "rns_conversions_per_pairing": "lower",
+    # RLC batch verification (models/rlc.py / scripts/rlc_smoke.py): p50
+    # wall of one combined check over a full batch, and its speedup over
+    # the per-candidate check of the same batch (acceptance: >= 3x at
+    # batch 64 on the host path)
+    "rlc_verify_p50_ms": "lower",
+    "rlc_speedup_x": "higher",
 }
 
 # Metrics that exist once per Field backend. Their comparison key grows a
@@ -103,6 +109,8 @@ PER_FP_BACKEND = {
     "mont_muls_per_s",
     "pairing_p50_ms",
     "rns_conversions_per_pairing",
+    "rlc_verify_p50_ms",
+    "rlc_speedup_x",
 }
 
 

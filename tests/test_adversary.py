@@ -291,3 +291,68 @@ def test_overflow_counter_reported():
     proc.add(_sig(1))
     proc.add(_sig(2))
     assert proc.values()["sigDroppedOverflow"] == 1.0
+
+
+# -- RLC batch-check culprit attribution -------------------------------------
+
+
+def test_rlc_bisection_isolates_culprits_and_matches_per_candidate_penalties():
+    """A forged aggregate inside an RLC combined launch (models/rlc.py via
+    service/driver.py HostDevice) is isolated by bisection to exactly the
+    per-candidate culprit set, so PeerScorer penalties attributed off the
+    verdicts are bit-for-bit identical to per_candidate mode."""
+    from handel_tpu.models.bn254 import BN254Scheme
+    from handel_tpu.service.driver import HostDevice
+
+    scheme = BN254Scheme()
+    keys = [scheme.keygen(i) for i in range(8)]
+    pubs = [pk for _, pk in keys]
+
+    def agg(msg, idxs, forge=False):
+        bs = BitSet(8)
+        sig = None
+        for i in idxs:
+            bs.set(i)
+            s = forged_signature(keys[i][0], msg) if forge else keys[i][0].sign(msg)
+            sig = s if sig is None else sig.combine(s)
+        return (msg, pubs, bs, sig)
+
+    # six candidates over two messages; 1 and 4 are forged aggregates
+    items = [
+        agg(b"m1", [0, 1]),
+        agg(b"m1", [2, 3], forge=True),
+        agg(b"m1", [4, 5, 6]),
+        agg(b"m2", [1, 2]),
+        agg(b"m2", [3, 7], forge=True),
+        agg(b"m2", [5]),
+    ]
+    origins = [3, 4, 5, 6, 7, 2]  # packet origin of each candidate
+
+    pc = HostDevice(scheme.constructor)
+    v_pc = pc.fetch(pc.dispatch_multi(items))
+    assert v_pc == [True, False, True, True, False, True]
+
+    dev = HostDevice(
+        scheme.constructor, batch_check="rlc", rlc_rng=random.Random(7)
+    )
+    v_rlc = dev.fetch(dev.dispatch_multi(items))
+    assert v_rlc == v_pc  # bisection reached the exact culprit set
+    st = dev.rlc_stats
+    assert st.rlc_launches == 1
+    assert st.bisection_ct > 0 and st.bisection_depth_max >= 1
+
+    # attribute each failed verdict to its packet origin, as
+    # Handel._on_verify_failed does — identical verdicts give identical
+    # scorer state in both modes
+    def attribute(verdicts):
+        scorer = PeerScorer(clock=lambda: 0.0)
+        for origin, ok in zip(origins, verdicts):
+            if not ok:
+                scorer.report(origin)
+        return scorer
+
+    a, b = attribute(v_rlc), attribute(v_pc)
+    assert a.reports == b.reports == 2
+    for origin in origins:
+        assert a.score(origin) == b.score(origin), origin
+    assert a.score(4) > 0 and a.score(7) > 0

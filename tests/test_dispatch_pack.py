@@ -27,12 +27,15 @@ N = 130  # > MISS_CAP + 3 so the dense fallback class is reachable
 C = 8
 
 
-@pytest.fixture(scope="module")
-def device():
+@pytest.fixture(scope="module", params=["per_candidate", "rlc"])
+def device(request):
+    """Both batch-check modes (models/rlc.py): launch packing is shared
+    between the per-candidate and RLC launch classes, so every equivalence
+    property below must hold identically under either device mode."""
     rng = random.Random(11)
     sks = [rng.randrange(1, 1 << 20) for _ in range(N)]
     pks = [BN254PublicKey(p) for p in nat.g2_mul_batch([bn.G2_GEN] * N, sks)]
-    return BN254Device(pks, batch_size=C)
+    return BN254Device(pks, batch_size=C, batch_check=request.param)
 
 
 def _rand_request(rng, kind):
@@ -224,3 +227,20 @@ def test_batch_verify_bounds_dispatch_window(device, monkeypatch):
     assert len(out) == C * 12
     assert in_flight["max"] <= device.MAX_DISPATCH_AHEAD
     assert in_flight["now"] == 0
+
+
+def test_batch_check_mode_validated_and_routed(device):
+    """The device carries its validated check mode; rlc-mode dispatch
+    returns the rlc handle shape without compiling anything when the
+    launch has at most one valid candidate (no combined pre-launch)."""
+    assert device.batch_check in ("per_candidate", "rlc")
+    with pytest.raises(ValueError, match="per_candidate.*rlc"):
+        BN254Device(
+            [BN254PublicKey(bn.G2_GEN)], batch_size=1, batch_check="bogus"
+        )
+    if device.batch_check != "rlc":
+        return
+    bs = BitSet(N)  # empty bitset: candidate invalid, nothing pre-launched
+    handle = device.dispatch(b"m", [(bs, BN254Signature(bn.G1_GEN))])
+    assert handle[0] == "rlc" and handle[3] is None
+    assert device.fetch(handle) == [False]

@@ -1,0 +1,140 @@
+"""The windowed/bucketed MSM kernel (ops/curve.py `Curve.msm`) vs the host
+scalar oracle, plus the vectorized scalar-bit packers.
+
+Device property tests (random scalars, masked/hull candidates, G1 and G2,
+both fp backends, edge scalars 0 / 1 / 2^64-1) are slow tier like the rest
+of the curve-op graphs (see tests/test_curve_jax.py); the pure-host
+scalar_bits checks stay tier-1.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from handel_tpu.ops import bn254_ref as bn
+from handel_tpu.ops.curve import BN254Curves
+
+random.seed(0x35A1)
+
+
+def _host_msm(pts, ks, add, mul):
+    acc = None
+    for p, k in zip(pts, ks):
+        if k == 0 or p is None:
+            continue
+        t = mul(p, k) if k != 1 else p
+        acc = t if acc is None else add(acc, t)
+    return acc
+
+
+# -- tier-1: host scalar-bit packing --------------------------------------
+
+
+def test_scalar_bits_vectorized_matches_reference():
+    ks = [0, 1, (1 << 64) - 1, 0xDEADBEEF, random.randrange(1 << 256)]
+    for nbits in (64, 96, 256):
+        got = np.asarray(BN254Curves.scalar_bits([k % (1 << nbits) for k in ks], nbits))
+        want = np.zeros((nbits, len(ks)), np.uint32)
+        for j, k in enumerate(ks):
+            k %= 1 << nbits
+            for i in range(nbits):
+                want[nbits - 1 - i, j] = (k >> i) & 1
+        assert (got == want).all(), nbits
+
+
+def test_scalar_bits64_matches_scalar_bits():
+    ks = [0, 1, (1 << 64) - 1] + [random.randrange(1 << 64) for _ in range(5)]
+    got = np.asarray(BN254Curves.scalar_bits64(ks))
+    want = np.asarray(BN254Curves.scalar_bits(ks, nbits=64))
+    assert got.shape == (64, len(ks))
+    assert (got == want).all()
+
+
+# -- slow tier: the device MSM kernel vs the scalar oracle ----------------
+
+
+@pytest.fixture(scope="module")
+def curves():
+    return BN254Curves()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("window", [1, 2, 4])
+def test_g1_msm_random_and_edge_scalars(curves, window):
+    n, b = 4, 2
+    pts = [bn.g1_mul(bn.G1_GEN, random.randrange(1, bn.R)) for _ in range(n * b)]
+    ks = [random.randrange(0, 1 << 64) for _ in range(n * b)]
+    # edge scalars: 0 (identity contribution), 1, all-ones
+    ks[0], ks[1], ks[2] = 0, 1, (1 << 64) - 1
+    out = curves.g1.msm(curves.pack_g1(pts), curves.scalar_bits64(ks), n, window=window)
+    got = curves.unpack_g1(out)
+    for j in range(b):
+        want = _host_msm(
+            [pts[i * b + j] for i in range(n)],
+            [ks[i * b + j] for i in range(n)],
+            bn.g1_add, bn.g1_mul,
+        )
+        assert got[j] == want, (window, j)
+
+
+@pytest.mark.slow
+def test_g1_msm_masked_hull_lanes(curves):
+    """Zeroed scalar columns (the launch-hull mask) and infinity points
+    both contribute the identity; an all-masked lane sums to infinity."""
+    n, b = 4, 2
+    pts = [bn.g1_mul(bn.G1_GEN, random.randrange(1, bn.R)) for _ in range(n * b)]
+    pts[2 * b] = None  # infinity point block entry
+    ks = [random.randrange(1, 1 << 64) for _ in range(n * b)]
+    for i in range(n):  # lane 1 fully masked
+        ks[i * b + 1] = 0
+    out = curves.g1.msm(curves.pack_g1(pts), curves.scalar_bits64(ks), n, window=2)
+    got = curves.unpack_g1(out)
+    assert got[1] is None
+    want = _host_msm(
+        [pts[i * b] for i in range(n)], [ks[i * b] for i in range(n)],
+        bn.g1_add, bn.g1_mul,
+    )
+    assert got[0] == want
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("window", [2, 4])
+def test_g2_msm_random_scalars(curves, window):
+    n, b = 3, 1
+    pts = [bn.g2_mul(bn.G2_GEN, random.randrange(1, bn.R)) for _ in range(n * b)]
+    ks = [0, (1 << 64) - 1, random.randrange(1 << 64)]
+    out = curves.g2.msm(curves.pack_g2(pts), curves.scalar_bits64(ks), n, window=window)
+    got = curves.unpack_g2(out)
+    want = _host_msm(pts, ks, bn.g2_add, bn.g2_mul)
+    assert got[0] == want
+
+
+@pytest.mark.slow
+def test_g1_msm_rns_backend_matches_cios(curves):
+    """The MSM rides the Field backend seam: the rns kernel's result is
+    bit-exact with cios (the backend contract, tests/test_rns.py)."""
+    rns = BN254Curves(backend="rns")
+    n, b = 3, 1
+    pts = [bn.g1_mul(bn.G1_GEN, random.randrange(1, bn.R)) for _ in range(n * b)]
+    ks = [1, random.randrange(1 << 64), random.randrange(1 << 64)]
+    want = curves.unpack_g1(
+        curves.g1.msm(curves.pack_g1(pts), curves.scalar_bits64(ks), n, window=2)
+    )
+    got = rns.unpack_g1(
+        rns.g1.msm(rns.pack_g1(pts), rns.scalar_bits64(ks), n, window=2)
+    )
+    assert got == want
+    assert got[0] == _host_msm(pts, ks, bn.g1_add, bn.g1_mul)
+
+
+@pytest.mark.slow
+def test_g2_msm_rns_backend_matches_oracle():
+    rns = BN254Curves(backend="rns")
+    n = 2
+    pts = [bn.g2_mul(bn.G2_GEN, random.randrange(1, bn.R)) for _ in range(n)]
+    ks = [random.randrange(1 << 64), random.randrange(1 << 64)]
+    got = rns.unpack_g2(
+        rns.g2.msm(rns.pack_g2(pts), rns.scalar_bits64(ks), n, window=2)
+    )
+    assert got[0] == _host_msm(pts, ks, bn.g2_add, bn.g2_mul)

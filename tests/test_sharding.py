@@ -170,12 +170,16 @@ def test_launch_partition_rules_route_operands():
     specs = match_partition_rules(
         launch_partition_rules(),
         ["reg_x", "reg_y", "prefix", "mask", "sig_x", "sig_y",
-         "valid", "lo", "hi", "miss_idx"],
+         "valid", "lo", "hi", "miss_idx", "r_bits", "group_oh", "g_occ"],
     )
     for name in ("reg_x", "reg_y", "prefix"):
         assert specs[name] == P(None, "dp"), name
     assert specs["mask"] == P("dp", None)
     for name in ("sig_x", "sig_y", "valid", "lo", "hi", "miss_idx"):
+        assert specs[name] == P(), name
+    # RLC scalar-side operands are candidate-axis-last and must stay
+    # replicated — the mask row rule must not capture them.
+    for name in ("r_bits", "group_oh", "g_occ"):
         assert specs[name] == P(), name
     # a table without the catch-all terminal must refuse unknown operands
     with pytest.raises(ValueError, match="no partition rule"):
