@@ -712,6 +712,66 @@ def _swarm_metrics() -> dict:
         return {}
 
 
+def federation_bench(
+    rate_sps: float = 5.0,
+    duration_s: float = 8.0,
+    nodes: int = 6,
+) -> dict:
+    """Geo-federated open-loop robustness (service/federation.py driven by
+    sim/load.py): a seeded Poisson arrival clock against a 3-region
+    federation with a mid-run region kill + epoch-path recovery. Reports
+    the gold-tier open-loop arrival->verdict p99, the kill-to-first-
+    post-recovery-completion wall, and the fraction of arrivals that
+    spilled to a non-nearest region. This in-bench shape keeps the three
+    SIDE_METRICS fresh every round; the 10-minute capture form runs
+    through `sim load` (results/federation_report.json). Returns {} unless
+    every report check held — a run that dropped work or never recovered
+    must not publish a flattering p99.
+    """
+    import asyncio
+
+    from handel_tpu.sim.config import FederationParams, LoadParams
+    from handel_tpu.sim.load import LoadRun
+
+    lp = LoadParams(
+        rate_sps=rate_sps, duration_s=duration_s, nodes=nodes, seed=7
+    )
+    fp = FederationParams(
+        kill_region="us-east", session_ttl_s=15.0,
+        trace_capacity=1 << 14,
+    )
+    report = asyncio.run(LoadRun(lp, fp).run())
+    if not report["ok"]:
+        failed = [k for k, v in report["checks"].items() if not v]
+        print(
+            f"bench: federation bench checks failed: {failed}",
+            file=sys.stderr,
+        )
+        return {}
+    return {
+        "open_loop_p99_s": report["open_loop_p99_s"],
+        "region_recovery_s": report["region_recovery_s"],
+        "spillover_rate": report["spillover_rate"],
+    }
+
+
+def _federation_metrics() -> dict:
+    """federation_bench behind the degrade-don't-die contract (+ a shape
+    override for tests: HANDEL_TPU_BENCH_FEDERATION_SHAPE =
+    'rate_sps,duration_s,nodes')."""
+    shape = os.environ.get("HANDEL_TPU_BENCH_FEDERATION_SHAPE")
+    try:
+        if shape:
+            rate, duration, nodes = shape.split(",")
+            return federation_bench(
+                float(rate), float(duration), int(nodes)
+            )
+        return federation_bench()
+    except Exception as e:
+        print(f"bench: federation bench failed: {e}", file=sys.stderr)
+        return {}
+
+
 def _fleet_metrics() -> dict:
     """fleet_bench behind the degrade-don't-die contract (+ a shape
     override for tests: HANDEL_TPU_BENCH_FLEET_SHAPE =
@@ -1303,6 +1363,9 @@ def _measure() -> None:
         line.update(_small_batch_metrics())
         # vnode swarm: identities carried + bytes/identity + completion wall
         line.update(_swarm_metrics())
+        # geo-federation robustness: open-loop p99 under a region kill,
+        # recovery wall, spillover fraction (protocol-layer, no kernels)
+        line.update(_federation_metrics())
         # RLC batch-check plane: both check modes on every line, keyed per
         # fp_backend in bench_check (PER_FP_BACKEND) via the line's tag
         line["fp_backend"] = curves.F.backend
@@ -1378,6 +1441,7 @@ def _measure() -> None:
         line.update(_fleet_metrics())
         line.update(_small_batch_metrics())
         line.update(_swarm_metrics())
+        line.update(_federation_metrics())
         line["fp_backend"] = curves.F.backend
         line.update(_rlc_metrics())
         _emit(line)

@@ -67,6 +67,28 @@ class GeoConfig:
     def region_of(self, node_id: int) -> str:
         return self.regions[self.region_index(node_id)]
 
+    def _resolve_region(self, r: "str | int") -> int:
+        if isinstance(r, str):
+            try:
+                return list(self.regions).index(r)
+            except ValueError:
+                raise ValueError(
+                    f"unknown region {r!r} "
+                    f"(known: {', '.join(self.regions)})"
+                ) from None
+        if not 0 <= r < len(self.regions):
+            raise ValueError(
+                f"region index {r} out of range "
+                f"(0..{len(self.regions) - 1})"
+            )
+        return int(r)
+
+    def rtt(self, a: "str | int", b: "str | int") -> float:
+        """Region-to-region round-trip ms, by name or index — the public
+        lookup the front door (service/federation.py) routes by, so
+        callers never index the matrix representation directly."""
+        return float(self.rtt_ms[self._resolve_region(a)][self._resolve_region(b)])
+
     def validate(self) -> "GeoConfig":
         n = len(self.regions)
         if n == 0:

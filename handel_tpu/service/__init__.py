@@ -18,6 +18,13 @@ service rather than a one-shot run.
 """
 
 from handel_tpu.service.fairness import TenantQueue
+from handel_tpu.service.federation import (
+    Federation,
+    FrontDoor,
+    RegionDead,
+    RegionPlane,
+    RegionShedding,
+)
 from handel_tpu.service.session import (
     AdmissionRefused,
     Session,
@@ -31,6 +38,11 @@ from handel_tpu.service.session import (
 
 __all__ = [
     "AdmissionRefused",
+    "Federation",
+    "FrontDoor",
+    "RegionDead",
+    "RegionPlane",
+    "RegionShedding",
     "Session",
     "SessionManager",
     "TenantQueue",

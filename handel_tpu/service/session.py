@@ -346,8 +346,20 @@ class SessionManager:
         self.spawned_ct += 1
         return s
 
-    def start(self, sid: str) -> None:
-        self.sessions[sid].start(on_done=self._on_session_end)
+    def start(self, sid: str, on_done=None) -> None:
+        """Start a spawned session; `on_done` (optional) observes the
+        terminal session AFTER the manager's own accounting — the hook an
+        ingress layer (service/federation.py front door) tracks per-arrival
+        outcomes with."""
+        if on_done is None:
+            self.sessions[sid].start(on_done=self._on_session_end)
+            return
+
+        def chained(s: Session) -> None:
+            self._on_session_end(s)
+            on_done(s)
+
+        self.sessions[sid].start(on_done=chained)
 
     def _on_session_end(self, s: Session) -> None:
         """Watcher callback at threshold-reached/expired: account the

@@ -9,6 +9,7 @@ Usage: python -m handel_tpu.sim --config sim.toml --workdir out/
        python -m handel_tpu.sim serve sim.toml      (multi-session service)
        python -m handel_tpu.sim swarm sim.toml      (virtual-node swarm)
        python -m handel_tpu.sim soak                (lifecycle soak proof)
+       python -m handel_tpu.sim load                (open-loop federation load)
        python -m handel_tpu.sim scenario --config s.toml   (WAN scenario)
        python -m handel_tpu.sim confgen --scenario geo     (emit TOMLs)
 """
@@ -68,6 +69,42 @@ def main() -> int:
         if kargs.duration > 0:
             p.duration_s = kargs.duration
         report = asyncio.run(run_soak(p, kargs.workdir))
+        print(json.dumps(report))
+        return 0 if report["ok"] else 1
+    if len(sys.argv) > 1 and sys.argv[1] == "load":
+        # open-loop load subcommand (sim/load.py): seeded Poisson/diurnal/
+        # burst arrivals against a geo-federated verify plane with an
+        # optional mid-run region kill+recovery drill; writes the
+        # federation_report.json robustness artifact into --workdir
+        lap = argparse.ArgumentParser(prog="python -m handel_tpu.sim load")
+        lap.add_argument("--config", default="",
+                         help="TOML with [load] (+ optional [federation])")
+        lap.add_argument("--workdir", default="load_out")
+        lap.add_argument("--duration", type=float, default=0.0,
+                         help="override [load] duration_s")
+        lap.add_argument("--rate", type=float, default=0.0,
+                         help="override [load] rate_sps")
+        lap.add_argument("--metrics-port", type=int, default=None,
+                         help="serve /metrics while the run is live")
+        largs = lap.parse_args(sys.argv[2:])
+        from handel_tpu.sim.config import FederationParams, LoadParams
+        from handel_tpu.sim.load import run_load
+
+        if largs.config:
+            lcfg = load_config(largs.config)
+            lo, fe = lcfg.load, lcfg.federation
+        else:
+            lo, fe = LoadParams(rate_sps=4.0), FederationParams()
+        if largs.duration > 0:
+            lo.duration_s = largs.duration
+        if largs.rate > 0:
+            lo.rate_sps = largs.rate
+        if not lo.enabled():
+            lap.error("[load] rate_sps must be > 0 (or pass --rate)")
+        report = asyncio.run(
+            run_load(lo, fe, largs.workdir,
+                     metrics_port=largs.metrics_port)
+        )
         print(json.dumps(report))
         return 0 if report["ok"] else 1
     if len(sys.argv) > 1 and sys.argv[1] == "swarm":

@@ -190,6 +190,66 @@ class SoakParams:
 
 
 @dataclass
+class LoadParams:
+    """`[load]` section: the open-loop arrival generator (sim/load.py,
+    `python -m handel_tpu.sim load`). rate_sps = 0 keeps load mode off.
+
+    Unlike `[service]`/`[soak]` (closed-loop: the harness back-fills on
+    completion), sessions arrive on a seeded Poisson/diurnal/burst clock
+    whether or not the federation keeps up — open-loop p50/p99 and
+    goodput against `deadline_s` are the first-class metrics."""
+
+    rate_sps: float = 0.0  # mean session arrivals per second; 0 -> off
+    duration_s: float = 60.0  # arrival window (drain tail rides on top)
+    model: str = "poisson"  # arrival process: poisson | diurnal | burst
+    seed: int = 0  # arrival clock + origin sampling seed
+    nodes: int = 8  # Handel committee size per arriving session
+    deadline_s: float = 5.0  # per-session arrival->verdict deadline
+    # (goodput = completions inside it / arrivals)
+    tiers: str = "gold,silver,bronze,standard"  # round-robin SLO cycle
+    # -- diurnal model: rate * (1 + amplitude*sin(2*pi*t/period)) --------
+    diurnal_amplitude: float = 0.5  # peak swing as a fraction of the mean
+    diurnal_period_s: float = 30.0  # one day, compressed
+    # -- burst model: rate * burst_x inside each burst window ------------
+    burst_every_s: float = 10.0  # burst cadence
+    burst_x: float = 4.0  # rate multiplier inside a burst
+    burst_len_s: float = 2.0  # burst width
+
+    def enabled(self) -> bool:
+        return self.rate_sps > 0
+
+
+@dataclass
+class FederationParams:
+    """`[federation]` section: the geo-federated service plane the load
+    generator drives (service/federation.py). One MultiSessionCluster per
+    region of the `planet` preset; a front door routes each arrival to
+    the nearest healthy region by RTT, spilling over on shed/death."""
+
+    planet: str = "planet-3region"  # scenario/planets.py preset
+    geo_seed: int = 0
+    devices: int = 1  # verify-plane lanes per region cluster
+    batch_size: int = 32  # shared-launch width per region
+    queue_capacity: int = 512  # per-region SLO shed bound (fairness.py)
+    max_sessions: int = 64  # per-region live-session admission cap
+    session_ttl_s: float = 30.0  # per-session expiry inside a region
+    period_ms: float = 5.0  # session node gossip period
+    probe_interval_s: float = 0.25  # front-door health probe cadence
+    # capped exponential backoff when EVERY region refuses an arrival:
+    # min(retry_cap_ms, retry_base_ms * 2^attempt), retry_budget attempts
+    retry_base_ms: float = 50.0
+    retry_cap_ms: float = 500.0
+    retry_budget: int = 4
+    registry: int = 64  # validator-set size staged on region rejoin
+    shed_ceiling: float = 0.15  # acceptance bound on the global shed rate
+    # -- chaos: scheduled mid-run region kill + recovery -----------------
+    kill_region: str = ""  # region name; "" -> no kill drill
+    kill_at_frac: float = 0.35  # of the load window
+    recover_at_frac: float = 0.65
+    trace_capacity: int = 1 << 17  # flight-recorder ring (events)
+
+
+@dataclass
 class SwarmParams:
     """`[swarm]` section: the virtual-node runtime (handel_tpu/swarm/).
 
@@ -362,6 +422,10 @@ class SimConfig:
     service: ServiceParams = field(default_factory=ServiceParams)
     # -- lifecycle soak harness (sim/soak.py; `sim soak`) ------------------
     soak: SoakParams = field(default_factory=SoakParams)
+    # -- open-loop load generator (sim/load.py; `sim load`) ----------------
+    load: LoadParams = field(default_factory=LoadParams)
+    # -- geo federation the load drives (service/federation.py) ------------
+    federation: FederationParams = field(default_factory=FederationParams)
     # -- virtual-node swarm (handel_tpu/swarm/; `sim swarm`) ---------------
     swarm: SwarmParams = field(default_factory=SwarmParams)
     # -- WAN scenario engine (handel_tpu/scenario/; `sim scenario`) --------
@@ -462,6 +526,70 @@ def load_config(path: str) -> SimConfig:
         autotune_every_s=float(so.get("autotune_every_s", 5.0)),
         trace_capacity=int(so.get("trace_capacity", 1 << 17)),
     )
+    lo = raw.get("load", {})
+    cfg.load = LoadParams(
+        rate_sps=float(lo.get("rate_sps", 0.0)),
+        duration_s=float(lo.get("duration_s", 60.0)),
+        model=str(lo.get("model", "poisson")),
+        seed=int(lo.get("seed", 0)),
+        nodes=int(lo.get("nodes", 8)),
+        deadline_s=float(lo.get("deadline_s", 5.0)),
+        tiers=str(lo.get("tiers", "gold,silver,bronze,standard")),
+        diurnal_amplitude=float(lo.get("diurnal_amplitude", 0.5)),
+        diurnal_period_s=float(lo.get("diurnal_period_s", 30.0)),
+        burst_every_s=float(lo.get("burst_every_s", 10.0)),
+        burst_x=float(lo.get("burst_x", 4.0)),
+        burst_len_s=float(lo.get("burst_len_s", 2.0)),
+    )
+    if cfg.load.model not in ("poisson", "diurnal", "burst"):
+        raise ValueError(
+            "load.model must be one of 'poisson', 'diurnal', 'burst', got "
+            f"{cfg.load.model!r}"
+        )
+    if not 0.0 <= cfg.load.diurnal_amplitude < 1.0:
+        raise ValueError(
+            "load.diurnal_amplitude must be in [0, 1) — the rate must stay "
+            f"positive, got {cfg.load.diurnal_amplitude}"
+        )
+    fe = raw.get("federation", {})
+    cfg.federation = FederationParams(
+        planet=str(fe.get("planet", "planet-3region")),
+        geo_seed=int(fe.get("geo_seed", 0)),
+        devices=int(fe.get("devices", 1)),
+        batch_size=int(fe.get("batch_size", 32)),
+        queue_capacity=int(fe.get("queue_capacity", 512)),
+        max_sessions=int(fe.get("max_sessions", 64)),
+        session_ttl_s=float(fe.get("session_ttl_s", 30.0)),
+        period_ms=float(fe.get("period_ms", 5.0)),
+        probe_interval_s=float(fe.get("probe_interval_s", 0.25)),
+        retry_base_ms=float(fe.get("retry_base_ms", 50.0)),
+        retry_cap_ms=float(fe.get("retry_cap_ms", 500.0)),
+        retry_budget=int(fe.get("retry_budget", 4)),
+        registry=int(fe.get("registry", 64)),
+        shed_ceiling=float(fe.get("shed_ceiling", 0.15)),
+        kill_region=str(fe.get("kill_region", "")),
+        kill_at_frac=float(fe.get("kill_at_frac", 0.35)),
+        recover_at_frac=float(fe.get("recover_at_frac", 0.65)),
+        trace_capacity=int(fe.get("trace_capacity", 1 << 17)),
+    )
+    if cfg.federation.retry_base_ms <= 0 or (
+        cfg.federation.retry_cap_ms < cfg.federation.retry_base_ms
+    ):
+        raise ValueError(
+            "federation retry backoff needs retry_base_ms > 0 and "
+            f"retry_cap_ms >= retry_base_ms, got base "
+            f"{cfg.federation.retry_base_ms} / cap "
+            f"{cfg.federation.retry_cap_ms}"
+        )
+    if cfg.federation.kill_region and not (
+        0.0 < cfg.federation.kill_at_frac
+        < cfg.federation.recover_at_frac <= 1.0
+    ):
+        raise ValueError(
+            "federation kill drill needs 0 < kill_at_frac < recover_at_frac "
+            f"<= 1, got kill {cfg.federation.kill_at_frac} / recover "
+            f"{cfg.federation.recover_at_frac}"
+        )
     sc = raw.get("scenario", {})
     cfg.scenario = ScenarioParams(
         name=str(sc.get("name", "")),
@@ -612,6 +740,48 @@ def dump_config(cfg: SimConfig) -> str:
             f"control_interval_s = {cfg.soak.control_interval_s}",
             f"autotune_every_s = {cfg.soak.autotune_every_s}",
             f"trace_capacity = {cfg.soak.trace_capacity}",
+        ]
+    if cfg.load.enabled():
+        lo = cfg.load
+        lines += [
+            "",
+            "[load]",
+            f"rate_sps = {lo.rate_sps}",
+            f"duration_s = {lo.duration_s}",
+            f'model = "{lo.model}"',
+            f"seed = {lo.seed}",
+            f"nodes = {lo.nodes}",
+            f"deadline_s = {lo.deadline_s}",
+            f"tiers = {lo.tiers!r}",
+            f"diurnal_amplitude = {lo.diurnal_amplitude}",
+            f"diurnal_period_s = {lo.diurnal_period_s}",
+            f"burst_every_s = {lo.burst_every_s}",
+            f"burst_x = {lo.burst_x}",
+            f"burst_len_s = {lo.burst_len_s}",
+        ]
+    if cfg.load.enabled() or cfg.federation != FederationParams():
+        fe = cfg.federation
+        lines += [
+            "",
+            "[federation]",
+            f'planet = "{fe.planet}"',
+            f"geo_seed = {fe.geo_seed}",
+            f"devices = {fe.devices}",
+            f"batch_size = {fe.batch_size}",
+            f"queue_capacity = {fe.queue_capacity}",
+            f"max_sessions = {fe.max_sessions}",
+            f"session_ttl_s = {fe.session_ttl_s}",
+            f"period_ms = {fe.period_ms}",
+            f"probe_interval_s = {fe.probe_interval_s}",
+            f"retry_base_ms = {fe.retry_base_ms}",
+            f"retry_cap_ms = {fe.retry_cap_ms}",
+            f"retry_budget = {fe.retry_budget}",
+            f"registry = {fe.registry}",
+            f"shed_ceiling = {fe.shed_ceiling}",
+            f'kill_region = "{fe.kill_region}"',
+            f"kill_at_frac = {fe.kill_at_frac}",
+            f"recover_at_frac = {fe.recover_at_frac}",
+            f"trace_capacity = {fe.trace_capacity}",
         ]
     if cfg.scenario.enabled():
         sc = cfg.scenario

@@ -43,6 +43,7 @@ from handel_tpu.lifecycle import (
 )
 from handel_tpu.models.fake import FakeScheme
 from handel_tpu.service.driver import HostDevice, MultiSessionCluster
+from handel_tpu.sim.report_checks import SOAK_CHECKS, attach
 
 # scheduling-jitter floor for the swap-gap bound: a CI hypervisor can
 # stretch any 2 ms sleep past 2x p50 with no swap involved at all
@@ -266,22 +267,7 @@ class SoakRun:
             2 * gaps["gap_p50_ms"], gaps["gap_p99_ms"], JITTER_FLOOR_MS
         )
         soak_p99 = summary["session_p99_s"]
-        checks = {
-            # every spawned session reached a terminal verdict, none of
-            # them by expiry: zero dropped futures across swap + lane loss
-            "zero_dropped": summary["expired"] == 0 and unresolved == 0,
-            "epoch_advanced": self.epochs.rotations == 1
-            and summary["epoch"] >= 1,
-            # the swap hid between launches: neither the measured stall
-            # nor the launch gap straddling it exceeded the bound
-            "swap_bounded": stall_ms <= bound_ms
-            and gaps["swap_gap_ms"] <= bound_ms,
-            "lane_replaced": self.autoscaler.lanes_replaced >= 1
-            and len(self.cluster.service.plane) >= p.devices,
-            "p99_within_slo": bool(tiers)
-            and all(t["met"] for t in tiers.values()),
-        }
-        return {
+        report = {
             # bench-record shape (scripts/bench_check.py): headline +
             # SIDE_METRICS keys flat on the record, detail nested
             "metric": "soak_p99_s",
@@ -290,8 +276,6 @@ class SoakRun:
             "captured_at": time.strftime(
                 "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
             ),
-            "ok": all(checks.values()),
-            "checks": checks,
             "epoch_swap_stall_ms": round(stall_ms, 3),
             "soak_p99_s": soak_p99,
             "shed_rate": summary["shed_rate"],
@@ -305,7 +289,10 @@ class SoakRun:
                 "expired": summary["expired"],
                 "unresolved": unresolved,
                 "swap_gap_bound_ms": round(bound_ms, 3),
+                "epoch_rotations": self.epochs.rotations,
                 "lane_lost": self.lane_lost_index,
+                "lanes_replaced": self.autoscaler.lanes_replaced,
+                "devices_floor": p.devices,
                 "gaps": gaps,
                 "tiers": tiers,
                 # the causal attribution the autotuner last acted on
@@ -315,6 +302,10 @@ class SoakRun:
                 "lifecycle": self.controller.values(),
             },
         }
+        # the shared invariant specs (sim/report_checks.py) stamp `checks`
+        # + `ok` — the same predicates soak_smoke re-asserts, so the
+        # artifact and the gate can't drift
+        return attach(report, SOAK_CHECKS)
 
 
 async def run_soak(p, workdir: str, logger=DEFAULT_LOGGER) -> dict:

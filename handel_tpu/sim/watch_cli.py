@@ -166,9 +166,35 @@ def aggregate(scrapes: list[dict]) -> dict:
             if did is not None:
                 devices.setdefault(did, {})[field] = v
 
+    # geo-federation plane (service/federation.py): one row per region
+    # from the `region` label dimension, beside the front-door aggregates
+    regions: dict[str, dict] = {}
+    for field, name in (
+        ("healthy", "handel_federation_region_healthy"),
+        ("arrivals", "handel_federation_arrivals"),
+        ("admitted", "handel_federation_admitted"),
+        ("spill_in", "handel_federation_spill_in"),
+        ("live", "handel_federation_sessions_live"),
+        ("completed", "handel_federation_completed"),
+        ("shed_rate", "handel_federation_shed_rate"),
+        ("epoch", "handel_federation_epoch"),
+        ("kills", "handel_federation_kills"),
+    ):
+        for labels, v in _samples(fams, name):
+            rid = labels.get("region")
+            if rid is not None:
+                regions.setdefault(rid, {})[field] = v
+
     def first(name):
         s = _samples(fams, name)
         return s[0][1] if s else None
+
+    def first_global(name):
+        # skip region-labeled samples of families that exist on both the
+        # federation plane and the per-region plane (e.g. epoch)
+        s = [v for labels, v in _samples(fams, name)
+             if "region" not in labels]
+        return s[0] if s else None
 
     return {
         "sessions": sessions,
@@ -231,6 +257,22 @@ def aggregate(scrapes: list[dict]) -> dict:
         "trace_events": total("handel_trace_trace_events"),
         "trace_dropped": total("handel_trace_trace_dropped"),
         "trace_rate": mean("handel_trace_trace_span_rate"),
+        # geo-federation plane (service/federation.py) + the open-loop
+        # load harness's own gauges (sim/load.py values())
+        "regions": regions,
+        "fed_regions_total": first_global("handel_federation_regions_total"),
+        "fed_regions_healthy": first_global(
+            "handel_federation_regions_healthy"
+        ),
+        "fed_retries": total("handel_federation_front_door_retries"),
+        "fed_spillovers": total("handel_federation_spillover_ct"),
+        "fed_sheds": total("handel_federation_front_door_sheds"),
+        "fed_failures": total("handel_federation_front_door_failures"),
+        "fed_epoch": first_global("handel_federation_epoch"),
+        "load_arrivals": first("handel_load_arrivals"),
+        "load_p50": first("handel_load_open_loop_p50_s"),
+        "load_p99": first("handel_load_open_loop_p99_s"),
+        "load_goodput": first("handel_load_goodput"),
         "families": len(fams),
     }
 
@@ -347,6 +389,47 @@ def render_devices(model: dict) -> list[str]:
     return lines
 
 
+def render_federation(model: dict) -> list[str]:
+    """Geo-federation row block (service/federation.py): front-door
+    aggregates, one row per region from the `region` label, and the
+    open-loop arrival gauges — the `sim watch` surface of a
+    `sim load` run (sim/load.py) with --metrics-port."""
+    regions = model.get("regions") or {}
+    if not regions and model.get("fed_regions_total") is None:
+        return []
+    lines = [
+        f"federation  regions "
+        f"{_num(model.get('fed_regions_healthy'))}/"
+        f"{_num(model.get('fed_regions_total'))} healthy  "
+        f"spillovers {_num(model.get('fed_spillovers'))}  "
+        f"retries {_num(model.get('fed_retries'))}  "
+        f"sheds {_num(model.get('fed_sheds'))}  "
+        f"failures {_num(model.get('fed_failures'))}  "
+        f"epoch {_num(model.get('fed_epoch'))}"
+    ]
+    for rid in sorted(regions):
+        row = regions[rid]
+        up = "up" if row.get("healthy", 0.0) >= 1.0 else "DOWN"
+        sr = row.get("shed_rate")
+        lines.append(
+            f"  {rid:>10} {up:<4}"
+            f"  live {int(row.get('live', 0)):>4}"
+            f"  done {int(row.get('completed', 0)):>6}"
+            f"  spill-in {int(row.get('spill_in', 0)):>4}"
+            f"  shed {('--' if sr is None else f'{sr:.1%}')}"
+            f"  kills {int(row.get('kills', 0))}"
+        )
+    if model.get("load_arrivals") is not None:
+        gp = model.get("load_goodput")
+        lines.append(
+            f"  open-loop  arrivals {_num(model.get('load_arrivals'))}"
+            f"  p50 {_ms(model.get('load_p50'))}"
+            f"  p99 {_ms(model.get('load_p99'))}"
+            f"  goodput {('--' if gp is None else f'{gp:.1%}')}"
+        )
+    return lines
+
+
 def render(model: dict, endpoints: list[str], up: int, tick: int) -> str:
     """One dashboard frame as plain text (the caller adds ANSI)."""
     lines = [
@@ -385,6 +468,10 @@ def render(model: dict, endpoints: list[str], up: int, tick: int) -> str:
     if drows:
         lines.append("")
         lines.extend(drows)
+    frows = render_federation(model)
+    if frows:
+        lines.append("")
+        lines.extend(frows)
     lines.append("")
     lines.append(
         f"verify   p50 {_ms(model['verify_p50'])}  "

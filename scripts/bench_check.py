@@ -100,6 +100,14 @@ SIDE_METRICS = {
     # batch 64 on the host path)
     "rlc_verify_p50_ms": "lower",
     "rlc_speedup_x": "higher",
+    # geo-federation robustness (bench.py federation_bench / sim load /
+    # scripts/load_smoke.py): gold-tier open-loop arrival->verdict p99
+    # under a mid-run region kill, wall from recovery start to the
+    # revived region's first completion, and the fraction of arrivals
+    # that spilled to a non-nearest region
+    "open_loop_p99_s": "lower",
+    "region_recovery_s": "lower",
+    "spillover_rate": "lower",
 }
 
 # Metrics that exist once per Field backend. Their comparison key grows a

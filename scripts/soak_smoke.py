@@ -33,6 +33,7 @@ import tempfile
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from handel_tpu.sim.config import SoakParams  # noqa: E402
+from handel_tpu.sim.report_checks import SOAK_CHECKS, assert_checks  # noqa: E402
 from handel_tpu.sim.soak import run_soak  # noqa: E402
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -66,25 +67,10 @@ def main(argv=None) -> int:
         )
         for name, ok in report["checks"].items():
             print(f"  check {name}: {'ok' if ok else 'FAILED'}")
-        assert report["checks"]["zero_dropped"], (
-            f"dropped work: expired={soak['expired']} "
-            f"unresolved={soak['unresolved']}"
-        )
-        assert report["checks"]["epoch_advanced"], (
-            "epoch rotation did not complete"
-        )
-        assert report["checks"]["swap_bounded"], (
-            f"epoch swap not hidden between launches: "
-            f"stall {report['epoch_swap_stall_ms']}ms / swap gap "
-            f"{soak['gaps']['swap_gap_ms']}ms vs bound "
-            f"{soak['swap_gap_bound_ms']}ms"
-        )
-        assert report["checks"]["lane_replaced"], (
-            "forced lane loss was not repaired by the autoscaler"
-        )
-        assert report["checks"]["p99_within_slo"], (
-            f"tier p99 breached its SLO target: {soak['tiers']}"
-        )
+        # the SAME predicate specs the report builder stamped `ok` with
+        # (sim/report_checks.py): re-evaluated from the report, so the
+        # smoke and the artifact can never assert different invariants
+        assert_checks(report, SOAK_CHECKS)
         assert report["ok"], f"soak checks failed: {report['checks']}"
 
         # regression gate: like-for-like SIDE_METRICS comparison against
