@@ -11,6 +11,7 @@ behind one scrapeable object, and a stdlib-only `MetricsServer`
     GET  /metrics            Prometheus text exposition format 0.0.4
     GET  /healthz            liveness (200 while the process serves)
     GET  /readyz             readiness (200 only when every probe passes)
+    GET  /alerts             alert/incident JSON snapshot (obs/plane.py)
     POST /debug/profile?seconds=N   on-demand profiler capture hook
 
 Metric naming convention: `handel_<plane>_<snake_case_key>` — e.g.
@@ -187,6 +188,13 @@ def _hist_family(name, help_, labeled_hists) -> Family:
         fam.samples.append(Sample({**labels, "le": "+Inf"}, h.count))
         fam.samples.append(Sample({**labels, "__kind": "sum"}, h.sum))
         fam.samples.append(Sample({**labels, "__kind": "count"}, h.count))
+        if h.count:
+            # observed extrema: quantile() clamps to [lo, hi], so a scrape
+            # that only carries bucket edges reconstructs edge quantiles
+            # biased to the geometric midpoint. Carrying min/max makes the
+            # exposition round trip exact (merged_histogram reads them back).
+            fam.samples.append(Sample({**labels, "__kind": "min"}, h.lo))
+            fam.samples.append(Sample({**labels, "__kind": "max"}, h.hi))
     return fam
 
 
@@ -286,6 +294,14 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         self.scrapes = 0
         self.scrape_errors = 0
+        #: `GET /alerts` JSON payload source (obs/plane.py AlertPlane
+        #: .alerts_payload); None -> the endpoint answers 501
+        self.alerts_source: Callable[[], dict] | None = None
+
+    def set_alerts_source(self, fn: Callable[[], dict] | None) -> None:
+        """Wire the /alerts endpoint to a payload callable (the alert
+        plane's rule/incident snapshot). Replaceable: last writer wins."""
+        self.alerts_source = fn
 
     # -- registration -------------------------------------------------------
 
@@ -447,7 +463,7 @@ def parse_exposition(text: str) -> dict[str, dict]:
             labels = {}
         mname = mname.strip()
         base, suffix = mname, ""
-        for suf in ("_bucket", "_sum", "_count"):
+        for suf in ("_bucket", "_sum", "_count", "_min", "_max"):
             cand = mname[: -len(suf)]
             if mname.endswith(suf) and cand in types \
                     and types[cand] == "histogram":
@@ -474,6 +490,7 @@ def merged_histogram(fams: dict, name: str) -> LogHistogram | None:
     h = LogHistogram()
     per_labels: dict[tuple, list[tuple[float, float]]] = {}
     total_sum = 0.0
+    obs_lo = obs_hi = None
     for labels, v in fam["samples"]:
         suffix = labels.get("__suffix", "")
         key = tuple(sorted(
@@ -484,6 +501,10 @@ def merged_histogram(fams: dict, name: str) -> LogHistogram | None:
             per_labels.setdefault(key, []).append((float(labels["le"]), v))
         elif suffix == "_sum":
             total_sum += v
+        elif suffix == "_min":
+            obs_lo = v if obs_lo is None else min(obs_lo, v)
+        elif suffix == "_max":
+            obs_hi = v if obs_hi is None else max(obs_hi, v)
     for buckets in per_labels.values():
         acc = 0.0
         for le, cum in sorted(buckets):
@@ -498,6 +519,13 @@ def merged_histogram(fams: dict, name: str) -> LogHistogram | None:
             lo, _ = LogHistogram.bucket_bounds(i)
             h.lo = min(h.lo, lo)
             h.hi = max(h.hi, le)
+    # observed extrema from the _min/_max samples override the bucket-edge
+    # approximation: quantile() clamps to [lo, hi], so with these restored
+    # the round trip through the exposition format is exact
+    if obs_lo is not None:
+        h.lo = obs_lo
+    if obs_hi is not None:
+        h.hi = obs_hi
     h.sum = total_sum
     return h if h.count else None
 
@@ -528,6 +556,18 @@ class _Handler(BaseHTTPRequestHandler):
             ok, status = reg.ready()
             body = json.dumps({"ready": ok, "checks": status}).encode() + b"\n"
             self._reply(200 if ok else 503, body, "application/json")
+        elif path == "/alerts":
+            src = reg.alerts_source
+            if src is None:
+                self._reply(501, b"no alert plane wired on this node\n")
+                return
+            try:
+                payload = src()
+            except Exception as e:  # a broken plane must not kill the server
+                self._reply(500, f"alerts snapshot failed: {e}\n".encode())
+                return
+            body = json.dumps(payload).encode() + b"\n"
+            self._reply(200, body, "application/json")
         else:
             self._reply(404, b"not found\n")
 

@@ -70,6 +70,17 @@ class LaneAutoscaler:
         self.lanes_grown = 0
         self.lanes_shrunk = 0
         self.lanes_replaced = 0
+        self.incident_nudges = 0
+        self._repair_first = False
+
+    def notify_incident(self, kind: str = "") -> None:
+        """Incident-plane hook (obs/incidents.py listener): a breaker-storm
+        incident makes the next tick repair-first — the grow/shrink
+        cooldown is waived once so replacement capacity attaches on the
+        very next control interval instead of waiting out a cooldown that
+        was meant for ordinary load wiggle."""
+        self.incident_nudges += 1
+        self._repair_first = True
 
     def _recent_fill(self) -> float:
         """Mean launch fill since the previous tick (windowed, not
@@ -112,6 +123,11 @@ class LaneAutoscaler:
         fill = self._recent_fill()
         active = [l for l in svc.plane.lanes if not l.draining]
         now = self.clock()
+        if self._repair_first:
+            # incident nudge consumed: repairs above already ran, and the
+            # scaling pass below sees a waived cooldown this one tick
+            self._repair_first = False
+            self._last_change = -1e18
         if now - self._last_change >= self.cooldown_s:
             if (
                 (depth >= self.scale_up_depth or fill >= self.high_fill)
@@ -153,6 +169,7 @@ class LaneAutoscaler:
             "lanesGrown": float(self.lanes_grown),
             "lanesShrunk": float(self.lanes_shrunk),
             "lanesReplaced": float(self.lanes_replaced),
+            "incidentNudgesCt": float(self.incident_nudges),
             "fillSignal": self.last_fill_signal,
         }
 

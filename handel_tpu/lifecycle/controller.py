@@ -30,6 +30,7 @@ class LifecycleController:
         autoscaler=None,
         autotuner=None,
         epoch_manager=None,
+        alert_plane=None,
         report_source: Callable[[], dict | None] | None = None,
         interval_s: float = 0.25,
         logger: Logger = DEFAULT_LOGGER,
@@ -40,6 +41,10 @@ class LifecycleController:
         self.autoscaler = autoscaler
         self.autotuner = autotuner
         self.epoch_manager = epoch_manager
+        # detection-and-incident plane (obs/plane.py AlertPlane): ticked
+        # on the same cadence as the actuators it feeds, so an incident's
+        # autoscaler nudge lands at most one interval after detection
+        self.alert_plane = alert_plane
         self.report_source = report_source
         self.interval_s = interval_s
         self.log = logger
@@ -59,6 +64,15 @@ class LifecycleController:
         async with self._lock:
             self.ticks += 1
             out: dict = {}
+            if self.alert_plane is not None:
+                # evaluate BEFORE the autoscaler: a breaker-storm incident
+                # opened this tick nudges the autoscaler pass below
+                try:
+                    out["alerts"] = self.alert_plane.tick()
+                except Exception as exc:
+                    self.log.warn(
+                        "lifecycle", f"alert plane tick failed: {exc!r}"
+                    )
             if self.autoscaler is not None:
                 out["autoscaler"] = await self.autoscaler.tick()
             if self.autotuner is not None and self.report_source is not None:
@@ -93,11 +107,14 @@ class LifecycleController:
             out.update(self.autotuner.values())
         if self.epoch_manager is not None:
             out.update(self.epoch_manager.values())
+        if self.alert_plane is not None:
+            out.update(self.alert_plane.values())
         return out
 
     def gauge_keys(self) -> set[str]:
         keys: set[str] = set()
-        for part in (self.autoscaler, self.autotuner, self.epoch_manager):
+        for part in (self.autoscaler, self.autotuner, self.epoch_manager,
+                     self.alert_plane):
             if part is not None:
                 keys |= part.gauge_keys()
         return keys

@@ -1,0 +1,33 @@
+"""Detection-and-incident plane over the existing observability surfaces.
+
+The repo emits every signal a production verify service needs (reporter
+`values()` planes, LogHistogram quantiles, causal traces, region-labeled
+federation gauges) but until this package nothing *interpreted* them — a
+human watching `sim watch` was the alerting system. `obs/` closes the
+loop:
+
+- `slo.py`       multi-window error-budget burn-rate evaluation over the
+                 tiered SLO targets (service/fairness.py) and the
+                 federation goodput/shed planes
+- `detect.py`    streaming EWMA + MAD z-score anomaly detectors,
+                 attachable to any reporter key or histogram quantile,
+                 seeded-deterministic and O(1) memory per series
+- `incidents.py` firing rules open/escalate/close Incident objects with
+                 a causal-attribution snapshot captured at open time
+- `plane.py`     AlertPlane composes the three, ticks from the
+                 LifecycleController, exports `handel_alerts_*` /
+                 `handel_incidents_*` metrics and the `/alerts` endpoint
+"""
+
+from handel_tpu.obs.detect import (  # noqa: F401
+    Detection,
+    DetectorBank,
+    EwmaDetector,
+    MadDetector,
+    counter_rate,
+    histogram_quantile_source,
+    reporter_key_source,
+)
+from handel_tpu.obs.incidents import Incident, IncidentLog  # noqa: F401
+from handel_tpu.obs.plane import AlertPlane  # noqa: F401
+from handel_tpu.obs.slo import BurnRateEvaluator, BurnRule  # noqa: F401

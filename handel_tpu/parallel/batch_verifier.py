@@ -123,6 +123,8 @@ class BatchVerifierService:
                 recorder.name_thread(
                     lane.trace_tid, f"device-lane-{lane.index}"
                 )
+        for lane in self.plane.lanes:
+            self._hook_breaker(lane)
         self.max_delay = max_delay_ms / 1000.0
         self.max_inflight = max(1, max_inflight)
         # -- resilience plane: per-lane breakers + host failover ------------
@@ -456,6 +458,24 @@ class BatchVerifierService:
             )
         return stall
 
+    def _hook_breaker(self, lane: DeviceLane) -> None:
+        """Make this lane's breaker transitions observable: each state
+        edge emits a trace instant on the lane's own trace thread so
+        incident attribution (obs/incidents.py) can cite the exact
+        open/half-open/close sequence between scrapes. The monotonic
+        count itself rides the breaker (`transitions`, summed into
+        values() breakerTransitionsCt)."""
+        def on_transition(prev: str, new: str,
+                          _lane: DeviceLane = lane) -> None:
+            if self.rec is not None:
+                self.rec.instant(
+                    "breaker_transition", tid=_lane.trace_tid,
+                    cat="resilience",
+                    args={"lane": _lane.index, "from": prev, "to": new},
+                )
+
+        lane.breaker.on_transition = on_transition
+
     def attach_lane(self, engine, breaker: CircuitBreaker | None = None,
                     mesh: bool = False) -> DeviceLane:
         """Grow the verify plane by one lane, live (LaneAutoscaler scale-up
@@ -465,6 +485,7 @@ class BatchVerifierService:
         latency-plane mesh lane (parallel/mesh_plane.py enable_latency_
         plane): only latency-mode groups are routed to it."""
         lane = self.plane.add_lane(engine, breaker, mesh=mesh)
+        self._hook_breaker(lane)
         if self.rec is not None:
             kind = "device-mesh" if mesh else "device-lane"
             self.rec.name_thread(lane.trace_tid, f"{kind}-{lane.index}")
@@ -1009,6 +1030,12 @@ class BatchVerifierService:
             ),
             "breakerOpenCt": float(
                 sum(l.breaker.open_count for l in self.plane.lanes)
+            ),
+            # every observed open/half-open/close edge across the fleet
+            # (utils/breaker.py transitions) — the storm-detection signal
+            # the alert plane differences (obs/detect.py counter_rate)
+            "breakerTransitionsCt": float(
+                sum(l.breaker.transitions for l in self.plane.lanes)
             ),
             "deviceRetryCt": float(self.device_retries),
             "failoverBatches": float(self.failover_batches),

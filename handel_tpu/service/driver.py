@@ -174,6 +174,7 @@ class MultiSessionCluster:
         mesh_batch_size: int = 8,
         batch_check: str = "per_candidate",
         recorder=None,
+        alert_p=None,
     ):
         self.k = sessions
         self.nodes = nodes
@@ -276,24 +277,87 @@ class MultiSessionCluster:
             self.metrics = reg
             self.metrics_server = MetricsServer(reg, port=metrics_port).start()
 
+        # serve-mode alert plane ([alerts] TOML section): breaker-storm
+        # detection over the shared verify plane, ticked by run()'s loop
+        # (serve has no LifecycleController) — /alerts and the
+        # handel_alerts_*/handel_incidents_* families ride the same
+        # metrics server as the session rows
+        self.alerts = None
+        self._alert_p = alert_p
+        if alert_p is not None and alert_p.enabled:
+            from handel_tpu.obs import AlertPlane, EwmaDetector
+
+            ap = AlertPlane.from_params(
+                alert_p, recorder=recorder,
+                trace_source=(
+                    (lambda: recorder.export()["traceEvents"])
+                    if recorder is not None else None
+                ),
+            )
+            ap.detectors.attach(
+                "breaker-storm",
+                lambda: self.service.values()["breakerTransitionsCt"],
+                EwmaDetector(alpha=alert_p.ewma_alpha,
+                             z_threshold=alert_p.z_threshold),
+                min_consecutive=alert_p.min_consecutive,
+                opens_incident=True,
+                direction="up",
+                hold_while=lambda: any(
+                    l.breaker.state == "open"
+                    for l in self.service.plane.lanes
+                ),
+            )
+            ap.detectors.attach(
+                "queue-depth",
+                lambda: float(self.service.queue_depth()),
+                EwmaDetector(alpha=alert_p.ewma_alpha,
+                             z_threshold=alert_p.z_threshold),
+                min_consecutive=max(2, alert_p.min_consecutive),
+                direction="up",
+            )
+            ap.add_context(
+                "open_breaker_lanes",
+                lambda: [
+                    l.index for l in self.service.plane.lanes
+                    if l.breaker.state == "open"
+                ],
+            )
+            self.alerts = ap
+            if self.metrics is not None:
+                ap.register_metrics(self.metrics)
+
+    async def _alert_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self._alert_p.tick_interval_s)
+            self.alerts.tick()
+
     async def run(self, timeout: float = 120.0) -> dict:
         """Spawn + start every session, await all terminal states, and
         return the run summary (the bench/capture record shape)."""
         t0 = time.perf_counter()
-        for i in range(self.k):
-            s = self.manager.spawn(
-                self.nodes,
-                threshold=self.threshold,
-                seed=self.seed_base + i,
-                config_tweak=self.config_tweak,
-                tier=self.tier_cycle[i % len(self.tier_cycle)]
-                if self.tier_cycle
-                else None,
-            )
-            self.manager.start(s.sid)
-            if self.spawn_stagger_s > 0:
-                await asyncio.sleep(self.spawn_stagger_s)
-        await self.manager.wait_all(timeout)
+        alert_task = (
+            asyncio.ensure_future(self._alert_loop())
+            if self.alerts is not None
+            else None
+        )
+        try:
+            for i in range(self.k):
+                s = self.manager.spawn(
+                    self.nodes,
+                    threshold=self.threshold,
+                    seed=self.seed_base + i,
+                    config_tweak=self.config_tweak,
+                    tier=self.tier_cycle[i % len(self.tier_cycle)]
+                    if self.tier_cycle
+                    else None,
+                )
+                self.manager.start(s.sid)
+                if self.spawn_stagger_s > 0:
+                    await asyncio.sleep(self.spawn_stagger_s)
+            await self.manager.wait_all(timeout)
+        finally:
+            if alert_task is not None:
+                alert_task.cancel()
         wall = time.perf_counter() - t0
         return self.summary(wall)
 
@@ -392,6 +456,7 @@ async def run_in_process(cfg, *, seed_base: int = 0,
         metrics_port=metrics_port,
         seed_base=seed_base,
         config_tweak=tweak,
+        alert_p=getattr(cfg, "alerts", None),
     )
     try:
         return await cluster.run(timeout or cfg.max_timeout_s)

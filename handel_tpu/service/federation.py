@@ -43,7 +43,6 @@ from handel_tpu.core.test_harness import FakeScheme
 from handel_tpu.core.trace import SERVICE_TID, trace_now
 from handel_tpu.network.geo import GeoConfig
 from handel_tpu.scenario.planets import planet_preset
-from handel_tpu.service.driver import MultiSessionCluster
 from handel_tpu.service.fairness import DEFAULT_TIER, TIERS
 from handel_tpu.service.session import AdmissionRefused, Session
 
@@ -90,6 +89,10 @@ class RegionPlane:
         self._build()
 
     def _build(self) -> None:
+        # deferred: driver -> parallel -> mesh_plane -> service would
+        # otherwise close an import cycle through this module
+        from handel_tpu.service.driver import MultiSessionCluster
+
         p = self.p
         self.cluster = MultiSessionCluster(
             sessions=0,  # open-loop arrivals drive it, not cluster.run()
@@ -249,6 +252,7 @@ class FrontDoor:
         self.sheds = 0  # arrivals that exhausted the budget on shed doors
         self.failures = 0  # arrivals that exhausted it on dead regions
         self.probe_rounds = 0
+        self.markdowns = 0  # monotonic healthy->down transitions
         self._probe_task: asyncio.Task | None = None
         # nearest-first routing tables, one per origin region
         self._order = {
@@ -268,6 +272,8 @@ class FrontDoor:
         if self.health[name] == healthy:
             return
         self.health[name] = healthy
+        if not healthy:
+            self.markdowns += 1
         (self.rehealthy_at if healthy else self.unhealthy_at)[name] = (
             time.monotonic()
         )
@@ -489,6 +495,9 @@ class Federation:
             "spilloverCt": float(fd.spillovers),
             "frontDoorSheds": float(fd.sheds),
             "frontDoorFailures": float(fd.failures),
+            # monotonic healthy->down mark-downs (passive + probe) so the
+            # alert plane can difference mark-down bursts between scrapes
+            "markdownCt": float(fd.markdowns),
             "probeRounds": float(fd.probe_rounds),
             "regionKills": float(sum(r.kills for r in self.planes)),
             "regionRecoveries": float(

@@ -62,13 +62,17 @@ def main() -> int:
         kap.add_argument("--duration", type=float, default=0.0,
                          help="override [soak] duration_s")
         kargs = kap.parse_args(sys.argv[2:])
-        from handel_tpu.sim.config import SoakParams
+        from handel_tpu.sim.config import AlertParams, SoakParams
         from handel_tpu.sim.soak import run_soak
 
-        p = load_config(kargs.config).soak if kargs.config else SoakParams()
+        if kargs.config:
+            kcfg = load_config(kargs.config)
+            p, al = kcfg.soak, kcfg.alerts
+        else:
+            p, al = SoakParams(), AlertParams()
         if kargs.duration > 0:
             p.duration_s = kargs.duration
-        report = asyncio.run(run_soak(p, kargs.workdir))
+        report = asyncio.run(run_soak(p, kargs.workdir, alert_p=al))
         print(json.dumps(report))
         return 0 if report["ok"] else 1
     if len(sys.argv) > 1 and sys.argv[1] == "load":
@@ -87,14 +91,20 @@ def main() -> int:
         lap.add_argument("--metrics-port", type=int, default=None,
                          help="serve /metrics while the run is live")
         largs = lap.parse_args(sys.argv[2:])
-        from handel_tpu.sim.config import FederationParams, LoadParams
+        from handel_tpu.sim.config import (
+            AlertParams,
+            FederationParams,
+            LoadParams,
+        )
         from handel_tpu.sim.load import run_load
 
         if largs.config:
             lcfg = load_config(largs.config)
-            lo, fe = lcfg.load, lcfg.federation
+            lo, fe, al = lcfg.load, lcfg.federation, lcfg.alerts
         else:
-            lo, fe = LoadParams(rate_sps=4.0), FederationParams()
+            lo, fe, al = (
+                LoadParams(rate_sps=4.0), FederationParams(), AlertParams()
+            )
         if largs.duration > 0:
             lo.duration_s = largs.duration
         if largs.rate > 0:
@@ -103,7 +113,7 @@ def main() -> int:
             lap.error("[load] rate_sps must be > 0 (or pass --rate)")
         report = asyncio.run(
             run_load(lo, fe, largs.workdir,
-                     metrics_port=largs.metrics_port)
+                     metrics_port=largs.metrics_port, alert_p=al)
         )
         print(json.dumps(report))
         return 0 if report["ok"] else 1

@@ -250,6 +250,35 @@ class FederationParams:
 
 
 @dataclass
+class AlertParams:
+    """`[alerts]` section: the detection-and-incident plane (handel_tpu/
+    obs/). Rides every harness that carries a control loop — `sim load`
+    ticks it beside the federation, `sim soak` through the
+    LifecycleController, `sim serve` beside its metrics registry. All
+    knobs default to the production shape; `window_scale` compresses the
+    burn windows so a 45 s drill exercises the same multi-window math a
+    30-day SLO would."""
+
+    enabled: bool = True
+    # burn-rate evaluation (obs/slo.py): fast/slow window pair, scaled
+    fast_window_s: float = 60.0
+    slow_window_s: float = 900.0
+    window_scale: float = 1.0
+    page_x: float = 14.4  # page when BOTH windows burn >= this multiple
+    warn_x: float = 6.0
+    goodput_slo: float = 0.95  # deadline-met fraction the goodput rule holds
+    # anomaly detection (obs/detect.py)
+    z_threshold: float = 6.0
+    ewma_alpha: float = 0.3
+    min_consecutive: int = 1  # anomalous ticks before a series fires
+    seed: int = 0  # MAD frugal-sketch coin-flip stream
+    # incident lifecycle (obs/incidents.py): flap suppression pair
+    min_hold_s: float = 2.0  # quiet time required before close
+    cooldown_s: float = 5.0  # refire inside this reopens, not re-mints
+    tick_interval_s: float = 0.25  # evaluation cadence
+
+
+@dataclass
 class SwarmParams:
     """`[swarm]` section: the virtual-node runtime (handel_tpu/swarm/).
 
@@ -426,6 +455,8 @@ class SimConfig:
     load: LoadParams = field(default_factory=LoadParams)
     # -- geo federation the load drives (service/federation.py) ------------
     federation: FederationParams = field(default_factory=FederationParams)
+    # -- SLO alerting + incident plane (handel_tpu/obs/) -------------------
+    alerts: AlertParams = field(default_factory=AlertParams)
     # -- virtual-node swarm (handel_tpu/swarm/; `sim swarm`) ---------------
     swarm: SwarmParams = field(default_factory=SwarmParams)
     # -- WAN scenario engine (handel_tpu/scenario/; `sim scenario`) --------
@@ -589,6 +620,50 @@ def load_config(path: str) -> SimConfig:
             "federation kill drill needs 0 < kill_at_frac < recover_at_frac "
             f"<= 1, got kill {cfg.federation.kill_at_frac} / recover "
             f"{cfg.federation.recover_at_frac}"
+        )
+    al = raw.get("alerts", {})
+    cfg.alerts = AlertParams(
+        enabled=bool(al.get("enabled", True)),
+        fast_window_s=float(al.get("fast_window_s", 60.0)),
+        slow_window_s=float(al.get("slow_window_s", 900.0)),
+        window_scale=float(al.get("window_scale", 1.0)),
+        page_x=float(al.get("page_x", 14.4)),
+        warn_x=float(al.get("warn_x", 6.0)),
+        goodput_slo=float(al.get("goodput_slo", 0.95)),
+        z_threshold=float(al.get("z_threshold", 6.0)),
+        ewma_alpha=float(al.get("ewma_alpha", 0.3)),
+        min_consecutive=int(al.get("min_consecutive", 1)),
+        seed=int(al.get("seed", 0)),
+        min_hold_s=float(al.get("min_hold_s", 2.0)),
+        cooldown_s=float(al.get("cooldown_s", 5.0)),
+        tick_interval_s=float(al.get("tick_interval_s", 0.25)),
+    )
+    if cfg.alerts.fast_window_s >= cfg.alerts.slow_window_s:
+        raise ValueError(
+            "alerts needs fast_window_s < slow_window_s, got fast "
+            f"{cfg.alerts.fast_window_s} / slow {cfg.alerts.slow_window_s}"
+        )
+    if cfg.alerts.warn_x >= cfg.alerts.page_x:
+        raise ValueError(
+            "alerts needs warn_x < page_x, got warn "
+            f"{cfg.alerts.warn_x} / page {cfg.alerts.page_x}"
+        )
+    if not 0.0 < cfg.alerts.goodput_slo < 1.0:
+        raise ValueError(
+            "alerts.goodput_slo must be in (0, 1), got "
+            f"{cfg.alerts.goodput_slo}"
+        )
+    if cfg.alerts.window_scale <= 0.0 or cfg.alerts.tick_interval_s <= 0.0:
+        raise ValueError(
+            "alerts needs window_scale > 0 and tick_interval_s > 0, got "
+            f"scale {cfg.alerts.window_scale} / tick "
+            f"{cfg.alerts.tick_interval_s}"
+        )
+    if cfg.alerts.min_hold_s < 0.0 or cfg.alerts.cooldown_s < 0.0:
+        raise ValueError(
+            "alerts needs min_hold_s >= 0 and cooldown_s >= 0, got "
+            f"hold {cfg.alerts.min_hold_s} / cooldown "
+            f"{cfg.alerts.cooldown_s}"
         )
     sc = raw.get("scenario", {})
     cfg.scenario = ScenarioParams(
@@ -782,6 +857,26 @@ def dump_config(cfg: SimConfig) -> str:
             f"kill_at_frac = {fe.kill_at_frac}",
             f"recover_at_frac = {fe.recover_at_frac}",
             f"trace_capacity = {fe.trace_capacity}",
+        ]
+    if cfg.alerts != AlertParams():  # non-default alert shapes round-trip
+        al = cfg.alerts
+        lines += [
+            "",
+            "[alerts]",
+            f"enabled = {str(al.enabled).lower()}",
+            f"fast_window_s = {al.fast_window_s}",
+            f"slow_window_s = {al.slow_window_s}",
+            f"window_scale = {al.window_scale}",
+            f"page_x = {al.page_x}",
+            f"warn_x = {al.warn_x}",
+            f"goodput_slo = {al.goodput_slo}",
+            f"z_threshold = {al.z_threshold}",
+            f"ewma_alpha = {al.ewma_alpha}",
+            f"min_consecutive = {al.min_consecutive}",
+            f"seed = {al.seed}",
+            f"min_hold_s = {al.min_hold_s}",
+            f"cooldown_s = {al.cooldown_s}",
+            f"tick_interval_s = {al.tick_interval_s}",
         ]
     if cfg.scenario.enabled():
         sc = cfg.scenario

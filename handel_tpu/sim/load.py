@@ -45,6 +45,7 @@ import time
 from handel_tpu.core.logging import DEFAULT_LOGGER, Logger
 from handel_tpu.core.test_harness import FakeScheme
 from handel_tpu.core.trace import FlightRecorder
+from handel_tpu.obs import AlertPlane, BurnRule, EwmaDetector, MadDetector
 from handel_tpu.service.fairness import DEFAULT_TIER, TIERS
 from handel_tpu.service.federation import Federation
 from handel_tpu.service.session import STATE_DONE
@@ -141,9 +142,11 @@ class LoadRun:
     drive the chaos timeline, emit the report. Split from the CLI so
     tests and the bench can run short traces in-process."""
 
-    def __init__(self, load_p, fed_p, logger: Logger = DEFAULT_LOGGER):
+    def __init__(self, load_p, fed_p, alert_p=None,
+                 logger: Logger = DEFAULT_LOGGER):
         self.lp = load_p
         self.fp = fed_p
+        self.ap = alert_p
         self.log = logger
         self.rec = FlightRecorder(capacity=fed_p.trace_capacity)
         self.scheme = FakeScheme()
@@ -172,6 +175,141 @@ class LoadRun:
         self.recovery_first_completion_t: float | None = None
         self.rotation_stall_s = 0.0
         self.t0 = 0.0
+        # detection-and-incident plane (handel_tpu/obs/): burn rules over
+        # the tier/goodput/shed planes + the region-health detector the
+        # chaos drill validates
+        self.alerts: AlertPlane | None = (
+            self._build_alert_plane() if alert_p is not None
+            and alert_p.enabled else None
+        )
+
+    # -- the alert plane ----------------------------------------------------
+
+    def _tier_counts(self, tier: str) -> tuple[float, float]:
+        """Cumulative (good, bad) for one tier's burn rule: a resolved
+        arrival is good iff it completed inside the tier's p99 target —
+        sheds/failures/expiries burn the tier's budget too (an arrival the
+        service turned away is an SLO miss the user saw)."""
+        target = TIERS.get(tier, DEFAULT_TIER).p99_target_s
+        good = bad = 0
+        for r in self.records:
+            if r.outcome is None or (r.tier or "standard") != tier:
+                continue
+            if r.outcome == "completed" and r.latency_s() <= target:
+                good += 1
+            else:
+                bad += 1
+        return float(good), float(bad)
+
+    def _goodput_counts(self) -> tuple[float, float]:
+        good = bad = 0
+        for r in self.records:
+            if r.outcome is None:
+                continue
+            if (
+                r.outcome == "completed"
+                and r.latency_s() <= self.lp.deadline_s
+            ):
+                good += 1
+            else:
+                bad += 1
+        return float(good), float(bad)
+
+    def _shed_counts(self) -> tuple[float, float]:
+        shed = sum(1 for r in self.records if r.outcome == "shed")
+        other = sum(
+            1 for r in self.records
+            if r.outcome is not None and r.outcome != "shed"
+        )
+        return float(other), float(shed)
+
+    def _unhealthy_regions(self) -> list[str]:
+        return [
+            name for name, vals in self.fed.labeled_values().items()
+            if vals.get("regionHealthy", 1.0) < 1.0
+        ]
+
+    def _build_alert_plane(self) -> AlertPlane:
+        ap = self.ap
+        plane = AlertPlane.from_params(
+            ap, recorder=self.rec,
+            trace_source=lambda: self.rec.export()["traceEvents"],
+        )
+        ev = plane.evaluator
+        for tier in dict.fromkeys(self._tiers or ["standard"]):
+            ev.add_rule(
+                BurnRule(f"tier-{tier}-p99", budget=0.01,
+                         page_x=ap.page_x, warn_x=ap.warn_x,
+                         description=f"99% of {tier} arrivals inside "
+                                     "the tier p99 target"),
+                lambda t=tier: self._tier_counts(t),
+            )
+        ev.add_rule(
+            BurnRule("goodput", budget=1.0 - ap.goodput_slo,
+                     page_x=ap.page_x, warn_x=ap.warn_x,
+                     description="deadline-met fraction of all arrivals"),
+            self._goodput_counts,
+        )
+        ev.add_rule(
+            BurnRule("shed", budget=self.fp.shed_ceiling,
+                     page_x=ap.page_x, warn_x=ap.warn_x,
+                     description="attributed sheds under the federation "
+                                 "shed ceiling"),
+            self._shed_counts,
+        )
+        # the drill signal: a region dropping out of the healthy count is
+        # a step the EWMA catches in one tick; hold_while keeps the
+        # detection (and its incident) open until the region is back
+        plane.detectors.attach(
+            "region-health",
+            lambda: self.fed.values()["regionsHealthy"],
+            EwmaDetector(alpha=ap.ewma_alpha, z_threshold=ap.z_threshold),
+            min_consecutive=ap.min_consecutive,
+            opens_incident=True,
+            direction="down",
+            hold_while=lambda: bool(self._unhealthy_regions()),
+        )
+        # context series: anomalous values land in attribution snapshots
+        # but never open incidents on their own
+        plane.detectors.attach(
+            "open-loop-p99",
+            lambda: self.values()["openLoopP99S"] or None,
+            MadDetector(z_threshold=ap.z_threshold, seed=ap.seed),
+            min_consecutive=max(2, ap.min_consecutive),
+            direction="up",
+        )
+        plane.detectors.attach(
+            "frontdoor-markdowns",
+            lambda: self.fed.values()["markdownCt"],
+            EwmaDetector(alpha=ap.ewma_alpha, z_threshold=ap.z_threshold),
+            min_consecutive=ap.min_consecutive,
+            direction="up",
+        )
+        plane.add_context("unhealthy_regions", self._unhealthy_regions)
+        plane.add_context(
+            "front_door",
+            lambda: {
+                "markdowns": self.fed.front_door.markdowns,
+                "retries": self.fed.front_door.retries,
+                "spillovers": self.fed.front_door.spillovers,
+            },
+        )
+        # region incident -> front-door mark-down: the incident plane is
+        # a health signal beside the probes (FrontDoor.mark dedups, so a
+        # probe-detected death just makes this a no-op)
+        def on_incident(event: str, inc) -> None:
+            if event != "open":
+                return
+            for name in inc.attribution.get("unhealthy_regions", []):
+                self.fed.front_door.mark(name, False)
+
+        plane.incidents.add_listener(on_incident)
+        return plane
+
+    async def _alert_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.ap.tick_interval_s)
+            self.alerts.tick()
 
     # -- arrival path -------------------------------------------------------
 
@@ -267,6 +405,11 @@ class LoadRun:
             if fp.kill_region
             else None
         )
+        alert_task = (
+            asyncio.ensure_future(self._alert_loop())
+            if self.alerts is not None
+            else None
+        )
         try:
             for i, off in enumerate(offsets):
                 ahead = off - (time.monotonic() - t0)
@@ -286,12 +429,31 @@ class LoadRun:
             if chaos is not None:
                 await chaos
             await self._drain()
+            await self._await_incident_close()
         finally:
             if chaos is not None:
                 chaos.cancel()
+            if alert_task is not None:
+                alert_task.cancel()
             await self.fed.stop()
         wall = time.monotonic() - t0
         return self._report(wall)
+
+    async def _await_incident_close(self) -> None:
+        """After drain, give an open incident its min-hold of quiet so a
+        recovered drill run reports closed incidents, not a snapshot taken
+        mid-hold (bounded — a genuinely stuck condition still reports)."""
+        if self.alerts is None or self.alerts.incidents.current is None:
+            return
+        deadline = (
+            time.monotonic() + self.ap.min_hold_s
+            + 20.0 * self.ap.tick_interval_s
+        )
+        while (
+            self.alerts.incidents.current is not None
+            and time.monotonic() < deadline
+        ):
+            await asyncio.sleep(self.ap.tick_interval_s)
 
     async def _drain(self) -> None:
         """Let in-flight routing finish and every admitted session reach
@@ -393,6 +555,40 @@ class LoadRun:
             "rotation_stall_ms": round(self.rotation_stall_s * 1e3, 3),
         }
 
+    def _alert_block(self) -> tuple[dict | None, float, float]:
+        """(nested alerts block, detection_latency_ms,
+        false_positive_rate). Detection latency is first-incident-open
+        minus region-kill time; an open with no kill in flight (or before
+        it) is a false positive — clean control runs must report 0.0 by
+        opening nothing at all."""
+        if self.alerts is None:
+            return None, 0.0, 0.0
+        log = self.alerts.incidents
+        expected = 0
+        latency_ms = 0.0
+        for inc in log.incidents:
+            if self.kill_t is not None and inc.opened_at >= self.kill_t:
+                expected += 1
+                if expected == 1:
+                    latency_ms = round(
+                        (inc.opened_at - self.kill_t) * 1e3, 3
+                    )
+        total = len(log.incidents)
+        fp_rate = (total - expected) / total if total else 0.0
+        ev = self.alerts.evaluator
+        block = {
+            "rules": {
+                name: {
+                    "state": state,
+                    "burn_fast": round(ev.burns(name)[0], 4),
+                    "burn_slow": round(ev.burns(name)[1], 4),
+                }
+                for name, state in ev.states().items()
+            },
+            "report": log.to_report(self.t0),
+        }
+        return block, latency_ms, round(fp_rate, 4)
+
     def _report(self, wall_s: float) -> dict:
         lp, fp = self.lp, self.fp
         fd = self.fed.front_door
@@ -421,6 +617,7 @@ class LoadRun:
             else _quantile(done, 0.99)
         )
         kill = self._kill_block()
+        alerts, detect_ms, fp_rate = self._alert_block()
         report = {
             # bench-record shape (scripts/bench_check.py): headline +
             # SIDE_METRICS keys flat on the record, detail nested
@@ -442,6 +639,9 @@ class LoadRun:
                 fd.spillovers / arrivals, 4
             ) if arrivals else 0.0,
             "goodput": round(met / arrivals, 4) if arrivals else 0.0,
+            "detection_latency_ms": detect_ms,
+            "false_positive_rate": fp_rate,
+            "alerts": alerts,
             "federation": {
                 "planet": fp.planet,
                 "model": lp.model,
@@ -477,12 +677,14 @@ class LoadRun:
 
 async def run_load(load_p, fed_p, workdir: str,
                    logger: Logger = DEFAULT_LOGGER,
-                   metrics_port: int | None = None) -> dict:
+                   metrics_port: int | None = None,
+                   alert_p=None) -> dict:
     """Run one open-loop trace and persist
     `<workdir>/federation_report.json` (+ the region-tagged trace dump
-    beside it for `sim trace --critical-path`)."""
+    beside it for `sim trace --critical-path`, and
+    `incident_report.json` when the alert plane is on)."""
     os.makedirs(workdir, exist_ok=True)
-    run = LoadRun(load_p, fed_p, logger=logger)
+    run = LoadRun(load_p, fed_p, alert_p=alert_p, logger=logger)
     server = None
     if metrics_port is not None:
         from handel_tpu.core.metrics import MetricsRegistry, MetricsServer
@@ -494,6 +696,8 @@ async def run_load(load_p, fed_p, workdir: str,
             gauges=run.fed.labeled_gauge_keys(),
         )
         reg.register_values("load", run)
+        if run.alerts is not None:
+            run.alerts.register_metrics(reg)
         reg.add_readiness("federation_up", lambda: True)
         server = MetricsServer(reg, port=metrics_port).start()
     try:
@@ -505,6 +709,19 @@ async def run_load(load_p, fed_p, workdir: str,
     with open(path, "w") as f:
         json.dump(report, f, indent=1)
         f.write("\n")
+    if report.get("alerts") is not None:
+        incident_path = os.path.join(workdir, "incident_report.json")
+        with open(incident_path, "w") as f:
+            json.dump(
+                {
+                    "detection_latency_ms": report["detection_latency_ms"],
+                    "false_positive_rate": report["false_positive_rate"],
+                    "kill": report["federation"]["kill"],
+                    **report["alerts"],
+                },
+                f, indent=1,
+            )
+            f.write("\n")
     # trace_* naming so `sim trace <workdir> --critical-path` resolves it
     run.rec.dump(os.path.join(workdir, "trace_federation.json"))
     fed = report["federation"]

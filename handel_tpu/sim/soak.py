@@ -42,6 +42,7 @@ from handel_tpu.lifecycle import (
     LifecycleController,
 )
 from handel_tpu.models.fake import FakeScheme
+from handel_tpu.obs import AlertPlane, EwmaDetector
 from handel_tpu.service.driver import HostDevice, MultiSessionCluster
 from handel_tpu.sim.report_checks import SOAK_CHECKS, attach
 
@@ -99,8 +100,9 @@ class SoakRun:
     emit the report. Split from the CLI so tests can run short soaks
     in-process with deterministic knobs."""
 
-    def __init__(self, p, logger=DEFAULT_LOGGER):
+    def __init__(self, p, alert_p=None, logger=DEFAULT_LOGGER):
         self.p = p
+        self.ap = alert_p
         self.log = logger
         self.launch_times: list[float] = []
         self.scheme = FakeScheme()
@@ -134,11 +136,19 @@ class SoakRun:
         self.autotuner = CriticalPathAutotuner(
             self.cluster.service, logger=logger
         )
+        # detection-and-incident plane: the breaker-storm drill's witness,
+        # ticked BY the controller so its autoscaler nudge lands in the
+        # same control interval
+        self.alerts: AlertPlane | None = (
+            self._build_alert_plane()
+            if alert_p is not None and alert_p.enabled else None
+        )
         self.controller = LifecycleController(
             self.cluster.service,
             autoscaler=self.autoscaler,
             autotuner=self.autotuner,
             epoch_manager=self.epochs,
+            alert_plane=self.alerts,
             report_source=self._stage_report,
             interval_s=p.control_interval_s,
             logger=logger,
@@ -152,6 +162,82 @@ class SoakRun:
         self.swap_t: float | None = None
         self.swap_stall_s = 0.0
         self.lane_lost_index: int | None = None
+        self.lane_loss_t: float | None = None
+        self.t0 = 0.0
+
+    # -- the alert plane ----------------------------------------------------
+
+    def _open_breaker_lanes(self) -> list[int]:
+        return [
+            l.index for l in self.cluster.service.plane.lanes
+            if l.breaker.state == "open"
+        ]
+
+    def _build_alert_plane(self) -> AlertPlane:
+        ap = self.ap
+        plane = AlertPlane.from_params(
+            ap, recorder=self.rec,
+            trace_source=lambda: self.rec.export()["traceEvents"],
+        )
+        # the drill signal: breaker transitions are ~0/tick in steady
+        # state, so a storm's burst of closed->open flips is a step the
+        # EWMA catches immediately; hold_while keeps the incident open
+        # until no lane is sitting on an open breaker
+        plane.detectors.attach(
+            "breaker-storm",
+            lambda: self.cluster.service.values()["breakerTransitionsCt"],
+            EwmaDetector(alpha=ap.ewma_alpha, z_threshold=ap.z_threshold),
+            min_consecutive=ap.min_consecutive,
+            opens_incident=True,
+            direction="up",
+            hold_while=lambda: bool(self._open_breaker_lanes()),
+        )
+        plane.detectors.attach(
+            "queue-depth",
+            lambda: float(self.cluster.service.queue_depth()),
+            EwmaDetector(alpha=ap.ewma_alpha, z_threshold=ap.z_threshold),
+            min_consecutive=max(2, ap.min_consecutive),
+            direction="up",
+        )
+        plane.add_context("open_breaker_lanes", self._open_breaker_lanes)
+        plane.add_context(
+            "autoscaler",
+            lambda: {
+                "lanes": len(self.cluster.service.plane),
+                "replaced": self.autoscaler.lanes_replaced,
+            },
+        )
+
+        # breaker-storm incident -> repair-first scaling: the autoscaler's
+        # next tick waives its grow/shrink cooldown
+        def on_incident(event: str, inc) -> None:
+            if event == "open" and "breaker" in inc.kind:
+                self.autoscaler.notify_incident(inc.kind)
+
+        plane.incidents.add_listener(on_incident)
+        return plane
+
+    def _alert_block(self) -> dict | None:
+        """Nested alerts block: the drill's detection latency (first
+        incident open after the forced storm) plus the incident report."""
+        if self.alerts is None:
+            return None
+        log = self.alerts.incidents
+        latency_ms = None
+        for inc in log.incidents:
+            if (
+                self.lane_loss_t is not None
+                and inc.opened_at >= self.lane_loss_t
+            ):
+                latency_ms = round(
+                    (inc.opened_at - self.lane_loss_t) * 1e3, 3
+                )
+                break
+        return {
+            "detection_latency_ms": latency_ms,
+            "incident_nudges": self.autoscaler.incident_nudges,
+            "report": log.to_report(self.t0),
+        }
 
     def _new_engine(self):
         return _tap_engine(
@@ -216,6 +302,7 @@ class SoakRun:
         autoscaler tick to replace it."""
         lane = self.cluster.service.plane.lanes[0]
         self.lane_lost_index = lane.index
+        self.lane_loss_t = time.monotonic()
         while lane.breaker.state != "open":
             lane.breaker.record_failure()
         # drive ticks directly (serialized against the background loop by
@@ -231,7 +318,7 @@ class SoakRun:
 
     async def run(self) -> dict:
         p = self.p
-        t0 = time.monotonic()
+        self.t0 = t0 = time.monotonic()
         t_end = t0 + p.duration_s
         self.cluster.service.start()
         self.controller.start()
@@ -246,6 +333,18 @@ class SoakRun:
             await spawner
             # drain: let the tail of live sessions reach their verdicts
             await self.cluster.manager.wait_all(p.session_ttl_s + 30.0)
+            if self.alerts is not None:
+                # a recovered drill should report a CLOSED incident: give
+                # the controller its min-hold of quiet ticks (bounded)
+                deadline = (
+                    time.monotonic() + self.ap.min_hold_s
+                    + 20.0 * p.control_interval_s
+                )
+                while (
+                    self.alerts.incidents.current is not None
+                    and time.monotonic() < deadline
+                ):
+                    await asyncio.sleep(p.control_interval_s)
         finally:
             spawner.cancel()
             await self.controller.stop()
@@ -300,6 +399,7 @@ class SoakRun:
                 "autotune_dominant": self.autotuner.last_dominant,
                 "summary": summary,
                 "lifecycle": self.controller.values(),
+                "alerts": self._alert_block(),
             },
         }
         # the shared invariant specs (sim/report_checks.py) stamp `checks`
@@ -308,10 +408,11 @@ class SoakRun:
         return attach(report, SOAK_CHECKS)
 
 
-async def run_soak(p, workdir: str, logger=DEFAULT_LOGGER) -> dict:
+async def run_soak(p, workdir: str, logger=DEFAULT_LOGGER,
+                   alert_p=None) -> dict:
     """Run one soak and persist `<workdir>/soak_report.json`."""
     os.makedirs(workdir, exist_ok=True)
-    run = SoakRun(p, logger=logger)
+    run = SoakRun(p, alert_p=alert_p, logger=logger)
     try:
         report = await run.run()
     finally:

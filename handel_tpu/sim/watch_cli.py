@@ -185,6 +185,20 @@ def aggregate(scrapes: list[dict]) -> dict:
             if rid is not None:
                 regions.setdefault(rid, {})[field] = v
 
+    # alert/incident plane (handel_tpu/obs/ via AlertPlane
+    # .register_metrics): one row per burn rule from the `rule` label
+    # dimension, beside the detector-bank and incident-log aggregates
+    alert_rules: dict[str, dict] = {}
+    for field, name in (
+        ("state", "handel_alerts_alert_state"),
+        ("burn_fast", "handel_alerts_burn_fast"),
+        ("burn_slow", "handel_alerts_burn_slow"),
+    ):
+        for labels, v in _samples(fams, name):
+            rid = labels.get("rule")
+            if rid is not None:
+                alert_rules.setdefault(rid, {})[field] = v
+
     def first(name):
         s = _samples(fams, name)
         return s[0][1] if s else None
@@ -273,6 +287,17 @@ def aggregate(scrapes: list[dict]) -> dict:
         "load_p50": first("handel_load_open_loop_p50_s"),
         "load_p99": first("handel_load_open_loop_p99_s"),
         "load_goodput": first("handel_load_goodput"),
+        # alert/incident plane (handel_tpu/obs/): burn-rule rows plus the
+        # incident-lifecycle counters — the `sim watch` alerting surface
+        "alert_rules": alert_rules,
+        "alerts_warn": total("handel_alerts_rules_warn"),
+        "alerts_page": total("handel_alerts_rules_page"),
+        "series_total": total("handel_alerts_series_total"),
+        "series_anomalous": total("handel_alerts_series_anomalous"),
+        "incidents_open": total("handel_incidents_incidents_open"),
+        "incidents_opened": total("handel_incidents_opened_ct"),
+        "incidents_closed": total("handel_incidents_closed_ct"),
+        "incidents_flaps": total("handel_incidents_flap_ct"),
         "families": len(fams),
     }
 
@@ -430,6 +455,41 @@ def render_federation(model: dict) -> list[str]:
     return lines
 
 
+#: handel_alerts_alert_state code -> display name (obs/slo.py STATE_CODE)
+_ALERT_STATE_NAMES = {0.0: "ok", 1.0: "WARN", 2.0: "PAGE"}
+
+
+def render_alerts(model: dict) -> list[str]:
+    """Alerts/incidents row block (handel_tpu/obs/): rule states with
+    their fast/slow burn multiples, anomalous detector series, and the
+    incident-lifecycle counters — non-ok rules render first."""
+    rules = model.get("alert_rules") or {}
+    if not rules and model.get("incidents_opened") is None:
+        return []
+    open_ct = model.get("incidents_open")
+    lines = [
+        f"alerts   warn {_num(model.get('alerts_warn'))}  "
+        f"page {_num(model.get('alerts_page'))}  "
+        f"anomalous {_num(model.get('series_anomalous'))}/"
+        f"{_num(model.get('series_total'))} series   "
+        f"incidents {'OPEN' if open_ct else 'none open'}  "
+        f"opened {_num(model.get('incidents_opened'))}  "
+        f"closed {_num(model.get('incidents_closed'))}  "
+        f"flaps {_num(model.get('incidents_flaps'))}"
+    ]
+    for rid in sorted(
+        rules, key=lambda r: (-rules[r].get("state", 0.0), r)
+    ):
+        row = rules[rid]
+        state = _ALERT_STATE_NAMES.get(row.get("state", 0.0), "?")
+        lines.append(
+            f"  {rid:>16} {state:<4}"
+            f"  burn fast {row.get('burn_fast', 0.0):7.2f}x"
+            f"  slow {row.get('burn_slow', 0.0):7.2f}x"
+        )
+    return lines
+
+
 def render(model: dict, endpoints: list[str], up: int, tick: int) -> str:
     """One dashboard frame as plain text (the caller adds ANSI)."""
     lines = [
@@ -472,6 +532,10 @@ def render(model: dict, endpoints: list[str], up: int, tick: int) -> str:
     if frows:
         lines.append("")
         lines.extend(frows)
+    arows = render_alerts(model)
+    if arows:
+        lines.append("")
+        lines.extend(arows)
     lines.append("")
     lines.append(
         f"verify   p50 {_ms(model['verify_p50'])}  "

@@ -108,6 +108,12 @@ SIDE_METRICS = {
     "open_loop_p99_s": "lower",
     "region_recovery_s": "lower",
     "spillover_rate": "lower",
+    # SLO alerting + incident plane (handel_tpu/obs/ / sim load /
+    # scripts/alert_smoke.py): wall from the forced region kill to the
+    # incident opening, and the unexpected-open fraction across the
+    # drill (clean control runs must hold this at exactly 0.0)
+    "detection_latency_ms": "lower",
+    "false_positive_rate": "lower",
 }
 
 # Metrics that exist once per Field backend. Their comparison key grows a

@@ -113,6 +113,71 @@ def test_exposition_golden():
     )
 
 
+def test_histogram_exposition_roundtrip_exact_quantiles():
+    """`sim watch` quantile-reconstruction bias fix (ISSUE 19 satellite):
+    the exposition carries the observed min/max as _min/_max pseudo-
+    samples, so a merged histogram's quantile() matches the original
+    EXACTLY — not just to within the log-bucket error — because the
+    clamp to [lo, hi] uses the true observed extrema, not bucket edges."""
+    import random
+
+    rng = random.Random(7)
+    h = LogHistogram()
+    for _ in range(500):
+        h.add(rng.lognormvariate(-3.0, 1.2))
+
+    class Rep:
+        def histograms(self):
+            return {"verifyLatencyS": h}
+
+    reg = MetricsRegistry()
+    reg.register_histograms("sigs", Rep())
+    fams = parse_exposition(reg.exposition())
+    rebuilt = merged_histogram(fams, "handel_sigs_verify_latency_s")
+    assert rebuilt is not None and rebuilt.count == h.count
+    assert rebuilt.lo == h.lo and rebuilt.hi == h.hi
+    for q in (0.001, 0.5, 0.9, 0.99, 0.999):
+        assert rebuilt.quantile(q) == h.quantile(q), q
+
+    # single-sample edge case: the reconstruction must return the sample
+    h1 = LogHistogram()
+    h1.add(0.00103)
+
+    class Rep1:
+        def histograms(self):
+            return {"verifyLatencyS": h1}
+
+    reg1 = MetricsRegistry()
+    reg1.register_histograms("sigs", Rep1())
+    fams1 = parse_exposition(reg1.exposition())
+    r1 = merged_histogram(fams1, "handel_sigs_verify_latency_s")
+    assert r1.quantile(0.5) == h1.quantile(0.5) == 0.00103
+
+
+def test_obs_plane_declares_every_gauge():
+    """ISSUE 19 satellite: every obs/ reporter key classifies explicitly
+    — a declared gauge or a *Ct counter — so the metrics plane never
+    falls back to the suffix heuristic on the alerts/incidents families."""
+    from handel_tpu.obs import BurnRateEvaluator, DetectorBank, IncidentLog
+
+    for rep in (BurnRateEvaluator(), DetectorBank(), IncidentLog()):
+        vals = rep.values()
+        gauges = rep.gauge_keys()
+        assert gauges <= set(vals), type(rep).__name__
+        for key in vals:
+            assert key in gauges or key.endswith("Ct"), (
+                f"{type(rep).__name__}.{key} is neither a declared gauge "
+                f"nor a *Ct counter — the suffix heuristic would guess"
+            )
+        # labeled planes declare explicitly too, and never call a
+        # counter a gauge
+        for key in rep.labeled_gauge_keys():
+            assert not key.endswith("Ct"), (
+                f"{type(rep).__name__} labeled gauge {key} looks like "
+                f"a counter"
+            )
+
+
 def test_reporter_collector_uses_gauge_keys():
     class Rep:
         def values(self):

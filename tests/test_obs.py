@@ -1,0 +1,646 @@
+"""Observability plane tests (ISSUE 19): burn-rate math vs the
+closed-form oracle, detector determinism under seed replay, the incident
+open -> escalate -> close lifecycle with flap suppression, the AlertPlane
+wiring (attribution snapshots, /alerts endpoint, metric families), the
+[alerts] config round trip, and the chaos-drill integration over a short
+in-process load run."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import urllib.error
+import urllib.request
+
+import pytest
+
+from handel_tpu.obs import (
+    AlertPlane,
+    BurnRateEvaluator,
+    BurnRule,
+    DetectorBank,
+    EwmaDetector,
+    IncidentLog,
+    MadDetector,
+    counter_rate,
+    histogram_quantile_source,
+    reporter_key_source,
+)
+
+# -- burn-rate math vs the closed-form oracle ---------------------------------
+
+
+def _run_constant_error(frac: float, budget: float = 0.01,
+                        page_x: float = 14.4, warn_x: float = 6.0):
+    """Feed a constant error fraction `frac` through both windows: the
+    closed form says burn = frac / budget on every window, exactly."""
+    ev = BurnRateEvaluator(fast_window_s=60.0, slow_window_s=900.0,
+                           clock=lambda: 0.0)
+    state = {"t": 0.0}
+
+    def src():
+        total = state["t"] * 10.0
+        return total * (1.0 - frac), total * frac
+
+    ev.add_rule(
+        BurnRule("r", budget=budget, page_x=page_x, warn_x=warn_x), src
+    )
+    for t in range(0, 1801, 30):
+        state["t"] = float(t)
+        ev.tick(now=float(t))
+    return ev
+
+
+def test_burn_oracle_1x_is_ok():
+    ev = _run_constant_error(0.01)  # exactly the budget: burn 1.0x
+    fast, slow = ev.burns("r")
+    assert fast == pytest.approx(1.0) and slow == pytest.approx(1.0)
+    assert ev.states()["r"] == "ok"
+    assert ev.firing() == []
+
+
+def test_burn_oracle_6x_is_warn():
+    ev = _run_constant_error(0.06)  # 6x the budget on both windows
+    fast, slow = ev.burns("r")
+    assert fast == pytest.approx(6.0) and slow == pytest.approx(6.0)
+    assert ev.states()["r"] == "warn"
+    assert ev.firing() == [("r", "warn")]
+    assert ev.values()["rulesWarn"] == 1.0
+    assert ev.warn_transitions == 1  # entered warn exactly once
+
+
+def test_burn_oracle_14p4x_is_page():
+    ev = _run_constant_error(0.144)  # the classic page threshold
+    fast, slow = ev.burns("r")
+    assert fast == pytest.approx(14.4) and slow == pytest.approx(14.4)
+    assert ev.states()["r"] == "page"
+    assert ev.firing() == [("r", "page")]
+    assert ev.values()["rulesPage"] == 1.0
+    assert ev.page_transitions == 1
+
+
+def test_burn_multiwindow_gates_on_both():
+    """A short burst burns the fast window hard but not the slow one:
+    multi-window alerting must NOT page on it."""
+    ev = BurnRateEvaluator(fast_window_s=60.0, slow_window_s=900.0,
+                           clock=lambda: 0.0)
+    counts = {"good": 0.0, "bad": 0.0}
+    ev.add_rule(BurnRule("r", budget=0.01),
+                lambda: (counts["good"], counts["bad"]))
+    # 15 minutes of clean traffic...
+    for t in range(0, 901, 30):
+        counts["good"] += 300.0
+        ev.tick(now=float(t))
+    # ...then one 60 s window of 100% errors
+    for t in range(930, 991, 30):
+        counts["bad"] += 300.0
+        ev.tick(now=float(t))
+    fast, slow = ev.burns("r")
+    assert fast >= 14.4  # the fast window alone would page
+    assert slow < 14.4  # but the slow window hasn't burned through
+    assert ev.states()["r"] != "page"
+
+
+def test_burn_rule_validation():
+    with pytest.raises(ValueError):
+        BurnRule("bad", budget=0.0)
+    with pytest.raises(ValueError):
+        BurnRule("bad", budget=1.5)
+    with pytest.raises(ValueError):
+        BurnRule("bad", budget=0.01, warn_x=20.0, page_x=14.4)
+    with pytest.raises(ValueError):
+        BurnRateEvaluator(fast_window_s=900.0, slow_window_s=60.0)
+    ev = BurnRateEvaluator()
+    ev.add_rule(BurnRule("r", budget=0.1), lambda: (1.0, 0.0))
+    with pytest.raises(ValueError):
+        ev.add_rule(BurnRule("r", budget=0.1), lambda: (1.0, 0.0))
+
+
+def test_burn_window_scale_compresses_the_drill():
+    """window_scale shrinks both windows so a ~seconds drill exercises
+    the same closed-form math as the production minutes-scale windows."""
+    ev = BurnRateEvaluator(fast_window_s=60.0, slow_window_s=900.0,
+                           window_scale=0.01, clock=lambda: 0.0)
+    assert ev.fast_window_s == pytest.approx(0.6)
+    assert ev.slow_window_s == pytest.approx(9.0)
+    counts = {"t": 0.0}
+
+    def src():
+        total = counts["t"] * 100.0
+        return total * 0.856, total * 0.144
+
+    ev.add_rule(BurnRule("r", budget=0.01), src)
+    t = 0.0
+    while t <= 18.0:
+        counts["t"] = t
+        ev.tick(now=t)
+        t += 0.3
+    assert ev.states()["r"] == "page"
+
+
+def test_burn_source_exception_skips_rule():
+    ev = BurnRateEvaluator(clock=lambda: 0.0)
+
+    def dying():
+        raise RuntimeError("source died")
+
+    ev.add_rule(BurnRule("r", budget=0.01), dying)
+    ev.tick(now=0.0)  # must not raise
+    assert ev.states()["r"] == "ok"
+
+
+# -- detector determinism + step detection ------------------------------------
+
+
+def _stream(seed: int = 3) -> list[float]:
+    import random
+
+    rng = random.Random(seed)
+    base = [rng.gauss(10.0, 0.5) for _ in range(60)]
+    return base + [25.0] * 10 + [rng.gauss(10.0, 0.5) for _ in range(20)]
+
+
+def test_ewma_detector_fires_on_step_and_replays():
+    d1 = EwmaDetector(alpha=0.3, z_threshold=6.0)
+    d2 = EwmaDetector(alpha=0.3, z_threshold=6.0)
+    s = _stream()
+    zs1 = [d1.update(x) for x in s]
+    zs2 = [d2.update(x) for x in s]
+    assert zs1 == zs2  # bit-identical replay
+    assert max(zs1[:60]) < 6.0  # quiet during the baseline
+    assert zs1[60] > 6.0  # the step fires immediately
+
+
+def test_mad_detector_seed_replay_and_robustness():
+    s = _stream()
+    d1, d2, d3 = MadDetector(seed=7), MadDetector(seed=7), MadDetector(seed=8)
+    zs1 = [d1.update(x) for x in s]
+    zs2 = [d2.update(x) for x in s]
+    zs3 = [d3.update(x) for x in s]
+    assert zs1 == zs2  # same seed: bit-identical
+    assert zs1 != zs3  # different seed: different coin flips
+    assert max(abs(z) for z in zs1[:60]) < 6.0
+    assert zs1[60] > 6.0  # robust z still catches the step
+
+
+def test_ewma_warmup_suppresses_early_z():
+    d = EwmaDetector(alpha=0.3, z_threshold=1.0, warmup=5)
+    assert all(d.update(x) == 0.0 for x in (1.0, 9.0, 1.0, 9.0, 1.0))
+    assert d.update(100.0) != 0.0  # past warmup: z flows
+
+
+def test_detector_bank_consecutive_and_direction():
+    bank = DetectorBank(clock=lambda: 0.0)
+    vals = {"x": 10.0}
+    bank.attach("up-only", lambda: vals["x"],
+                EwmaDetector(alpha=0.3, z_threshold=6.0, warmup=2),
+                min_consecutive=2, direction="up")
+    for _ in range(20):
+        bank.tick(now=0.0)
+    vals["x"] = 0.0  # huge step DOWN: an up-only series must not fire
+    assert bank.tick(now=1.0) == []
+    with pytest.raises(ValueError):
+        bank.attach("up-only", lambda: 0.0, EwmaDetector())
+    with pytest.raises(ValueError):
+        bank.attach("bad-dir", lambda: 0.0, EwmaDetector(),
+                    direction="sideways")
+
+
+def test_detector_bank_hold_while_decouples_recovery():
+    """A z detector spots the STEP then adapts; hold_while keeps the
+    series firing until the underlying condition actually clears."""
+    bank = DetectorBank(clock=lambda: 0.0)
+    vals = {"x": 10.0}
+    cond = {"broken": False}
+    bank.attach("s", lambda: vals["x"],
+                EwmaDetector(alpha=0.3, z_threshold=6.0, warmup=2),
+                min_consecutive=1, opens_incident=True, direction="down",
+                hold_while=lambda: cond["broken"])
+    for t in range(30):
+        assert bank.tick(now=float(t)) == []
+    vals["x"] = 0.0
+    cond["broken"] = True
+    fired = bank.tick(now=30.0)
+    assert [d.name for d in fired] == ["s"]
+    assert fired[0].opens_incident
+    # detector adapts within a few ticks, but the condition persists:
+    # hold_while must keep the series firing
+    for t in range(31, 50):
+        assert [d.name for d in bank.tick(now=float(t))] == ["s"]
+    cond["broken"] = False  # actual recovery
+    vals["x"] = 10.0
+    for _ in range(5):
+        out = bank.tick(now=50.0)
+    assert out == []
+    assert bank.values()["seriesAnomalous"] == 0.0
+
+
+def test_source_factories():
+    class Rep:
+        def values(self):
+            return {"depth": 7.0}
+
+    src = reporter_key_source(Rep(), "depth")
+    assert src() == 7.0
+    assert reporter_key_source(Rep(), "missing")() is None
+
+    from handel_tpu.core.trace import LogHistogram
+
+    h = LogHistogram()
+    for v in (0.01, 0.02, 0.04):
+        h.add(v)
+    q = histogram_quantile_source(lambda: h, 0.5)
+    assert q() == h.quantile(0.5)
+    assert histogram_quantile_source(lambda: None, 0.5)() is None
+
+    t = {"now": 0.0}
+    c = {"v": 0.0}
+    rate = counter_rate(lambda: c["v"], clock=lambda: t["now"])
+    assert rate() is None  # first sample primes
+    c["v"], t["now"] = 30.0, 10.0
+    assert rate() == pytest.approx(3.0)
+
+
+# -- incident lifecycle -------------------------------------------------------
+
+
+def test_incident_open_escalate_close():
+    t = {"now": 0.0}
+    events: list[tuple[str, int]] = []
+    log = IncidentLog(snapshot_fn=lambda: {"cause": "unit-test"},
+                      min_hold_s=2.0, cooldown_s=5.0,
+                      clock=lambda: t["now"])
+    log.add_listener(lambda ev, inc: events.append((ev, inc.id)))
+
+    log.observe([("goodput", "warn")], now=0.0)
+    inc = log.current
+    assert inc is not None and inc.severity == "warn"
+    assert inc.attribution == {"cause": "unit-test"}
+    # correlation: a second rule firing attaches, no second incident
+    log.observe([("goodput", "warn"), ("tier-gold-p99", "warn")], now=1.0)
+    assert log.current is inc and inc.rules == {"goodput", "tier-gold-p99"}
+    assert log.opened == 1
+    # escalation: a page firing upgrades severity exactly once
+    log.observe([("goodput", "page")], now=2.0)
+    assert inc.severity == "page" and log.escalated == 1
+    log.observe([("goodput", "page")], now=3.0)
+    assert log.escalated == 1
+    # close only after min_hold_s of continuous quiet
+    log.observe([], now=4.0)
+    assert log.current is inc  # quiet 0 s: still open
+    log.observe([], now=5.0)
+    log.observe([], now=6.1)
+    assert log.current is None and inc.state == "closed"
+    assert log.closed == 1
+    assert [e for e, _ in events] == ["open", "escalate", "close"]
+    names = [e["event"] for e in inc.timeline]
+    assert names == ["open", "correlate", "escalate", "close"]
+
+
+def test_incident_flap_reopens_within_cooldown():
+    t = {"now": 0.0}
+    log = IncidentLog(min_hold_s=1.0, cooldown_s=5.0,
+                      clock=lambda: t["now"])
+    log.observe([("r", "page")], now=0.0)
+    first = log.current
+    log.observe([], now=1.0)
+    log.observe([], now=2.5)
+    assert log.current is None and first.state == "closed"
+    # refire 2 s after close: inside the cooldown -> REOPEN, same id
+    log.observe([("r", "page")], now=4.5)
+    assert log.current is first and first.flaps == 1
+    assert log.opened == 1 and log.flapped == 1
+    log.observe([], now=5.0)
+    log.observe([], now=6.5)
+    assert log.current is None
+    # refire well past the cooldown: a genuinely new incident
+    log.observe([("r", "page")], now=60.0)
+    assert log.current is not first and log.current.id != first.id
+    assert log.opened == 2
+
+
+def test_incident_quiet_hold_resets_on_refire():
+    """Min-hold is CONTINUOUS quiet: a blip mid-hold restarts the clock
+    without closing or reopening anything."""
+    log = IncidentLog(min_hold_s=2.0, cooldown_s=5.0, clock=lambda: 0.0)
+    log.observe([("r", "warn")], now=0.0)
+    inc = log.current
+    log.observe([], now=1.0)
+    log.observe([("r", "warn")], now=2.0)  # blip: hold clock resets
+    log.observe([], now=3.0)
+    log.observe([], now=4.5)
+    assert log.current is inc  # only 1.5 s quiet since the blip
+    log.observe([], now=5.1)
+    assert log.current is None
+    assert inc.flaps == 0  # never closed mid-flap, so no flap counted
+
+
+def test_incident_report_rebases_timestamps():
+    log = IncidentLog(min_hold_s=1.0, clock=lambda: 0.0)
+    log.observe([("r", "page")], now=100.0)
+    log.observe([], now=101.0)
+    log.observe([], now=102.5)
+    rep = log.to_report(t0=100.0)
+    assert rep["opened"] == 1 and rep["closed"] == 1
+    inc = rep["incidents"][0]
+    assert inc["opened_at"] == 0.0
+    assert inc["closed_at"] == pytest.approx(2.5)
+    assert inc["timeline"][0]["at"] == 0.0
+
+
+def test_incident_trace_instants():
+    from handel_tpu.core.trace import FlightRecorder
+
+    rec = FlightRecorder(capacity=256)
+    log = IncidentLog(recorder=rec, min_hold_s=1.0, clock=lambda: 0.0)
+    log.observe([("r", "warn")], now=0.0)
+    log.observe([("r", "page")], now=0.5)
+    log.observe([], now=1.0)
+    log.observe([], now=2.5)
+    names = [e["name"] for e in rec.export()["traceEvents"]
+             if e.get("cat") == "incident"]
+    assert names == ["incident_open", "incident_escalate", "incident_close"]
+
+
+# -- the AlertPlane -----------------------------------------------------------
+
+
+class _Params:
+    """Duck-typed AlertParams (obs/ never imports sim/)."""
+
+    enabled = True
+    fast_window_s = 0.6
+    slow_window_s = 9.0
+    window_scale = 1.0
+    page_x = 14.4
+    warn_x = 6.0
+    z_threshold = 6.0
+    ewma_alpha = 0.3
+    min_consecutive = 1
+    seed = 0
+    min_hold_s = 0.5
+    cooldown_s = 2.0
+    tick_interval_s = 0.05
+
+
+def _drilled_plane():
+    """An AlertPlane driven through a synthetic region-kill drill with a
+    manual clock; returns (plane, clock dict)."""
+    t = {"now": 0.0}
+    plane = AlertPlane.from_params(_Params(), clock=lambda: t["now"])
+    health = {"regions": 3.0}
+    plane.detectors.attach(
+        "region-health", lambda: health["regions"],
+        EwmaDetector(alpha=0.3, z_threshold=6.0),
+        min_consecutive=1, opens_incident=True, direction="down",
+        hold_while=lambda: health["regions"] < 3.0,
+    )
+    plane.add_context("unhealthy_regions",
+                      lambda: ["us-east"] if health["regions"] < 3.0 else [])
+    counts = {"good": 0.0, "bad": 0.0}
+    plane.evaluator.add_rule(
+        BurnRule("goodput", budget=0.05),
+        lambda: (counts["good"], counts["bad"]),
+    )
+    return plane, t, health, counts
+
+
+def test_alert_plane_drill_opens_attributes_and_closes():
+    plane, t, health, counts = _drilled_plane()
+    while t["now"] < 3.0:  # healthy baseline
+        counts["good"] += 5.0
+        assert plane.tick() == []
+        t["now"] += 0.05
+    health["regions"] = 2.0  # the kill
+    kill_t = t["now"]
+    opened_at = None
+    while t["now"] < kill_t + 2.0:
+        counts["good"] += 5.0
+        plane.tick()
+        if plane.incidents.current is not None and opened_at is None:
+            opened_at = t["now"]
+        t["now"] += 0.05
+    assert opened_at is not None
+    assert opened_at - kill_t <= 0.2  # bounded detection latency
+    inc = plane.incidents.current
+    assert inc.attribution["unhealthy_regions"] == ["us-east"]
+    assert any(s["series"] == "region-health"
+               for s in inc.attribution["top_anomalous"])
+    health["regions"] = 3.0  # recovery
+    recover_t = t["now"]
+    while t["now"] < recover_t + 2.0:
+        counts["good"] += 5.0
+        plane.tick()
+        t["now"] += 0.05
+    assert plane.incidents.current is None
+    assert plane.incidents.opened == 1  # exactly one incident, now closed
+    assert inc.state == "closed"
+
+
+def test_alert_plane_metrics_families_and_alerts_endpoint():
+    from handel_tpu.core.metrics import (
+        MetricsRegistry,
+        MetricsServer,
+        parse_exposition,
+    )
+
+    plane, t, health, counts = _drilled_plane()
+    counts["good"] = 100.0
+    plane.tick()
+    t["now"] += 0.05
+    plane.tick()
+    reg = MetricsRegistry()
+    plane.register_metrics(reg)
+    fams = parse_exposition(reg.exposition())
+    for name in (
+        "handel_alerts_rules_total",
+        "handel_alerts_eval_ticks_ct",
+        "handel_alerts_series_total",
+        "handel_alerts_firings_ct",
+        "handel_incidents_incidents_open",
+        "handel_incidents_opened_ct",
+    ):
+        assert name in fams, sorted(fams)
+    # labeled rows ride the rule / series dimensions
+    labels = {l.get("rule") for l, _ in
+              fams["handel_alerts_burn_fast"]["samples"]}
+    assert labels == {"goodput"}
+    series = {l.get("series") for l, _ in
+              fams["handel_alerts_last_z"]["samples"]}
+    assert series == {"region-health"}
+    # gauge-vs-counter is declared, never guessed
+    assert fams["handel_alerts_rules_total"]["type"] == "gauge"
+    assert fams["handel_alerts_eval_ticks_ct"]["type"] == "counter"
+    assert fams["handel_incidents_incidents_open"]["type"] == "gauge"
+
+    srv = MetricsServer(reg, port=0).start()
+    try:
+        with urllib.request.urlopen(
+            f"http://{srv.address}/alerts", timeout=3
+        ) as r:
+            payload = json.loads(r.read())
+        assert payload["open"] is False
+        assert "goodput" in payload["rules"]
+        assert "region-health" in payload["series"]
+        assert payload["incidents"] == []
+    finally:
+        srv.stop()
+
+
+def test_alerts_endpoint_unwired_is_501():
+    from handel_tpu.core.metrics import MetricsRegistry, MetricsServer
+
+    srv = MetricsServer(MetricsRegistry(), port=0).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://{srv.address}/alerts", timeout=3
+            )
+        assert ei.value.code == 501
+    finally:
+        srv.stop()
+
+
+# -- [alerts] config ----------------------------------------------------------
+
+
+def test_alerts_config_round_trip(tmp_path):
+    from handel_tpu.sim.config import AlertParams, SimConfig, dump_config
+    from handel_tpu.sim.config import load_config
+
+    cfg = SimConfig()
+    assert cfg.alerts == AlertParams()  # enabled by default
+    cfg.alerts.window_scale = 0.02
+    cfg.alerts.z_threshold = 8.0
+    cfg.alerts.min_hold_s = 1.5
+    path = tmp_path / "alerts.toml"
+    path.write_text(dump_config(cfg))
+    loaded = load_config(str(path))
+    assert loaded.alerts.window_scale == 0.02
+    assert loaded.alerts.z_threshold == 8.0
+    assert loaded.alerts.min_hold_s == 1.5
+    assert loaded.alerts.page_x == 14.4  # untouched default survives
+
+
+def test_alerts_config_validation(tmp_path):
+    from handel_tpu.sim.config import load_config
+
+    bad = tmp_path / "bad.toml"
+    bad.write_text("[alerts]\nfast_window_s = 900.0\nslow_window_s = 60.0\n")
+    with pytest.raises(ValueError):
+        load_config(str(bad))
+    bad.write_text("[alerts]\nwarn_x = 20.0\npage_x = 14.4\n")
+    with pytest.raises(ValueError):
+        load_config(str(bad))
+    bad.write_text("[alerts]\ngoodput_slo = 1.5\n")
+    with pytest.raises(ValueError):
+        load_config(str(bad))
+
+
+# -- control wiring -----------------------------------------------------------
+
+
+def test_autoscaler_incident_nudge_waives_cooldown():
+    from handel_tpu.lifecycle.autoscaler import LaneAutoscaler
+
+    class _Svc:
+        fill_sum = 0.0
+        fill_launches = 0
+
+        class plane:
+            lanes: list = []
+
+        def queue_depth(self):
+            return 0
+
+    sc = LaneAutoscaler(_Svc(), engine_factory=lambda: None,
+                        cooldown_s=3600.0)
+    assert sc.values()["incidentNudgesCt"] == 0.0
+    sc.notify_incident("breaker-storm")
+    assert sc.incident_nudges == 1 and sc._repair_first
+
+
+def test_breaker_transition_counter_and_callback():
+    from handel_tpu.utils.breaker import CircuitBreaker
+
+    seen: list[tuple[str, str]] = []
+    t = {"now": 0.0}
+    b = CircuitBreaker(threshold=2, cooldown_s=10.0,
+                       clock=lambda: t["now"],
+                       on_transition=lambda p, n: seen.append((p, n)))
+    assert b.state == "closed" and b.transitions == 0
+    b.record_failure()
+    assert b.state == "closed"
+    b.record_failure()  # threshold: closed -> open
+    assert b.state == "open"
+    t["now"] = 11.0  # cooldown elapsed: open -> half-open (observed lazily)
+    assert b.allow()
+    b.record_success()  # half-open -> closed
+    assert b.state == "closed"
+    assert seen == [("closed", "open"), ("open", "half-open"),
+                    ("half-open", "closed")]
+    assert b.transitions == 3
+
+
+def test_frontdoor_markdown_counter():
+    from handel_tpu.sim.config import FederationParams
+    from handel_tpu.core.test_harness import FakeScheme
+    from handel_tpu.service.federation import Federation
+
+    fed = Federation(FederationParams(), scheme=FakeScheme())
+    assert fed.values()["markdownCt"] == 0.0
+    region = fed.region_names()[0]
+    fed.front_door.mark(region, False)
+    assert fed.front_door.markdowns == 1
+    fed.front_door.mark(region, False)  # dedup: still-down is no new mark
+    assert fed.front_door.markdowns == 1
+    fed.front_door.mark(region, True)
+    fed.front_door.mark(region, False)
+    assert fed.values()["markdownCt"] == 2.0
+
+
+# -- the chaos drill end to end (short in-process load run) -------------------
+
+
+@pytest.mark.slow
+def test_load_drill_exactly_one_attributed_incident(tmp_path):
+    """The acceptance drill in miniature: a ~6 s open-loop run with a
+    mid-run region kill opens exactly one incident, attributes it to the
+    killed region, and closes it after recovery; the clean control run
+    opens zero."""
+    from handel_tpu.sim.config import (
+        AlertParams,
+        FederationParams,
+        LoadParams,
+    )
+    from handel_tpu.sim.load import run_load
+
+    lp = LoadParams(rate_sps=6.0, duration_s=6.0, nodes=6, seed=11,
+                    deadline_s=8.0)
+    fp = FederationParams(kill_region="us-east", kill_at_frac=0.35,
+                          recover_at_frac=0.65)
+    ap = AlertParams(window_scale=0.01, min_hold_s=0.5, cooldown_s=2.0,
+                     tick_interval_s=0.1)
+    report = asyncio.run(
+        run_load(lp, fp, str(tmp_path / "drill"), alert_p=ap)
+    )
+    al = report["alerts"]
+    assert al is not None
+    incidents = al["report"]["incidents"]
+    assert len(incidents) == 1, incidents
+    inc = incidents[0]
+    assert inc["state"] == "closed"
+    assert "us-east" in inc["attribution"]["unhealthy_regions"]
+    assert report["detection_latency_ms"] > 0.0
+    assert report["detection_latency_ms"] < 2000.0
+    assert report["false_positive_rate"] == 0.0
+    assert os.path.exists(tmp_path / "drill" / "incident_report.json")
+
+    # clean control: no kill -> zero incidents, zero false positives
+    fp2 = FederationParams()
+    report2 = asyncio.run(
+        run_load(lp, fp2, str(tmp_path / "clean"), alert_p=ap)
+    )
+    assert report2["alerts"]["report"]["opened"] == 0
+    assert report2["false_positive_rate"] == 0.0
