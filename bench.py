@@ -787,6 +787,92 @@ def _fleet_metrics() -> dict:
         return {}
 
 
+def rollup_bench(
+    hosts: int = 4,
+    vnodes: int = 1024,
+    rounds: int = 20,
+) -> dict:
+    """Hierarchical roll-up plane (obs/rollup.py): `hosts` HostRollups
+    each folding `vnodes` reporter surfaces emit changed-keys deltas into
+    one master FleetRollup for `rounds` emission intervals. Reports the
+    master's merged series count (the O(hosts) contract: flat across
+    vnode sweeps), the wire bytes per host per emission interval (the
+    1 Hz default cadence makes that bytes/host/s), and the master-side
+    merge wall — all three must stay flat as identities scale.
+    """
+    import random as _random
+
+    from handel_tpu.core.trace import LogHistogram
+    from handel_tpu.obs.rollup import FleetRollup, HostRollup
+
+    rng = _random.Random(13)
+    fleet = FleetRollup(top_k=8, clock=lambda: 0.0)
+    states = []
+    hrs = []
+    for h in range(hosts):
+        state = [
+            {"msgSentCt": 0.0, "verifiedCt": 0.0, "levelRate": 0.0}
+            for _ in range(vnodes)
+        ]
+        states.append(state)
+
+        hist = LogHistogram()
+
+        class _Rep:
+            def __init__(self, state, hist):
+                self.state = state
+                self.hist = hist
+
+            def values(self):
+                return {"launchesCt": float(sum(
+                    v["verifiedCt"] for v in self.state))}
+
+            def gauge_keys(self):
+                return set()
+
+            def histograms(self):
+                return {"verifyLatencyS": self.hist}
+
+        hr = HostRollup(f"bench{h}", clock=lambda: 0.0)
+        hr.attach_fold(
+            "swarm",
+            lambda state=state: ((v, {"levelRate"}) for v in state),
+        )
+        hr.attach_reporter("device", _Rep(state, hist))
+        hrs.append((hr, hist))
+    for _ in range(rounds):
+        for h in range(hosts):
+            for v in states[h]:
+                v["msgSentCt"] += rng.randrange(1, 8)
+                v["verifiedCt"] += rng.randrange(0, 4)
+                v["levelRate"] = rng.randrange(0, 64) / 8.0
+            hrs[h][1].add(rng.randrange(1, 1 << 16) / 1e6)
+            hrs[h][0].emit(fleet.ingest)
+    series = fleet.series_count()  # refreshes last_merge_ms too
+    return {
+        "fleet_series_count": series,
+        "rollup_bytes_per_host_s": round(
+            fleet.ingest_bytes / hosts / rounds, 1
+        ),
+        "fleet_eval_ms": round(fleet.last_merge_ms, 3),
+    }
+
+
+def _rollup_metrics() -> dict:
+    """rollup_bench behind the degrade-don't-die contract (+ a shape
+    override for tests: HANDEL_TPU_BENCH_ROLLUP_SHAPE =
+    'hosts,vnodes,rounds')."""
+    shape = os.environ.get("HANDEL_TPU_BENCH_ROLLUP_SHAPE")
+    try:
+        if shape:
+            hosts, vnodes, rounds = (int(x) for x in shape.split(","))
+            return rollup_bench(hosts, vnodes, rounds)
+        return rollup_bench()
+    except Exception as e:
+        print(f"bench: rollup bench failed: {e}", file=sys.stderr)
+        return {}
+
+
 def rlc_bench(batch: int = 64, messages: int = 4, trials: int = 5) -> dict:
     """Random-linear-combination batch verification (models/rlc.py) vs the
     per-candidate pairing loop, host math path: one launch of `batch`
@@ -1366,6 +1452,9 @@ def _measure() -> None:
         # geo-federation robustness: open-loop p99 under a region kill,
         # recovery wall, spillover fraction (protocol-layer, no kernels)
         line.update(_federation_metrics())
+        # hierarchical roll-up plane: O(hosts) fleet series count, wire
+        # bytes/host/s, and master merge wall (obs/rollup.py)
+        line.update(_rollup_metrics())
         # RLC batch-check plane: both check modes on every line, keyed per
         # fp_backend in bench_check (PER_FP_BACKEND) via the line's tag
         line["fp_backend"] = curves.F.backend
@@ -1442,6 +1531,7 @@ def _measure() -> None:
         line.update(_small_batch_metrics())
         line.update(_swarm_metrics())
         line.update(_federation_metrics())
+        line.update(_rollup_metrics())
         line["fp_backend"] = curves.F.backend
         line.update(_rlc_metrics())
         _emit(line)

@@ -36,6 +36,7 @@ registry's own `handel_metrics_scrape_errors` counter instead of a 500.
 from __future__ import annotations
 
 import json
+import logging
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Iterable, Mapping
@@ -231,12 +232,19 @@ class _LabeledReporterCollector:
     device plane with label="device"
     (`handel_device_verifier_launches{device="3"} 12`)."""
 
-    def __init__(self, plane, reporter, label, labels, gauges):
+    def __init__(self, plane, reporter, label, labels, gauges,
+                 cap=0, on_drop=None):
         self.plane = plane
         self.reporter = reporter
         self.label = label
         self.labels = dict(labels or {})
         self._explicit = set(gauges) if gauges is not None else None
+        #: cardinality governance: >0 keeps the top-`cap` label values by
+        #: activity, folds the rest into one explicit `_overflow` row and
+        #: reports them via `on_drop` — truncation is never silent
+        self.cap = int(cap or 0)
+        self._on_drop = on_drop
+        self._dropped_logged: frozenset = frozenset()
 
     def _gauge_set(self):
         if self._explicit is not None:
@@ -249,11 +257,53 @@ class _LabeledReporterCollector:
             gk = getattr(self.reporter, "gauge_keys", None)
         return set(gk()) if callable(gk) else set()
 
+    def _apply_cap(self, rows: dict, declared) -> tuple[dict, list]:
+        """Top-`cap`-by-activity selection. Activity is the summed counter
+        mass of a row (gauges ignored so a hot session outranks a deep
+        queue); ties and all-gauge reporters fall back to total mass,
+        then label order for determinism. Dropped rows are summed into an
+        explicit `_overflow` row — the scrape still conserves counter
+        totals."""
+        def activity(vals) -> tuple:
+            counter_mass = sum(
+                float(v) for k, v in vals.items()
+                if not is_gauge_key(k, declared)
+            )
+            total = sum(float(v) for v in vals.values())
+            return (counter_mass, total)
+
+        ranked = sorted(rows, key=lambda lv: (activity(rows[lv]),
+                                              str(lv)), reverse=True)
+        keep = set(ranked[:self.cap])
+        dropped = [lv for lv in ranked[self.cap:]]
+        overflow: dict[str, float] = {}
+        for lv in dropped:
+            for k, v in rows[lv].items():
+                overflow[k] = overflow.get(k, 0.0) + float(v)
+        kept = {lv: rows[lv] for lv in rows if lv in keep}
+        kept["_overflow"] = overflow
+        return kept, dropped
+
     def collect(self) -> Iterable[Family]:
         declared = self._gauge_set()
+        rows = {lv: dict(vals)
+                for lv, vals in dict(self.reporter.labeled_values()).items()}
+        if self.cap > 0 and len(rows) > self.cap:
+            rows, dropped = self._apply_cap(rows, declared)
+            key = frozenset(str(lv) for lv in dropped)
+            if key != self._dropped_logged:
+                self._dropped_logged = key
+                logging.getLogger("handel_tpu.metrics").warning(
+                    "labeled family %s/%s over series cap %d: folded %d "
+                    "rows into _overflow: %s", self.plane, self.label,
+                    self.cap, len(dropped),
+                    ", ".join(sorted(key)[:16]),
+                )
+            if self._on_drop is not None:
+                self._on_drop(len(dropped))
         fams: dict[str, Family] = {}
-        for lv, vals in dict(self.reporter.labeled_values()).items():
-            for k, v in dict(vals).items():
+        for lv, vals in rows.items():
+            for k, v in vals.items():
                 name = metric_name(self.plane, k)
                 fam = fams.get(name)
                 if fam is None:
@@ -288,20 +338,38 @@ class MetricsRegistry:
     `/metrics` is hit, so an idle registry costs nothing on the hot path.
     """
 
-    def __init__(self):
+    def __init__(self, series_cap: int = 0):
         self._collectors: list = []
         self._readiness: dict[str, Callable[[], bool]] = {}
         self._lock = threading.Lock()
         self.scrapes = 0
         self.scrape_errors = 0
+        #: default per-family label-cardinality cap for labeled reporters
+        #: (0 = uncapped); [alerts] series_cap in the TOML
+        self.series_cap = int(series_cap or 0)
+        #: rows folded into `_overflow` across all capped collectors,
+        #: exported as handel_metrics_rollup_dropped_series_ct
+        self.dropped_series = 0
         #: `GET /alerts` JSON payload source (obs/plane.py AlertPlane
         #: .alerts_payload); None -> the endpoint answers 501
         self.alerts_source: Callable[[], dict] | None = None
+        #: `GET /fleet` JSON payload source (obs/rollup.py FleetRollup
+        #: .fleet_payload); None -> the endpoint answers 501
+        self.fleet_source: Callable[[], dict] | None = None
 
     def set_alerts_source(self, fn: Callable[[], dict] | None) -> None:
         """Wire the /alerts endpoint to a payload callable (the alert
         plane's rule/incident snapshot). Replaceable: last writer wins."""
         self.alerts_source = fn
+
+    def set_fleet_source(self, fn: Callable[[], dict] | None) -> None:
+        """Wire the /fleet endpoint to a payload callable (the fleet
+        roll-up's host/merge snapshot). Replaceable: last writer wins."""
+        self.fleet_source = fn
+
+    def _note_dropped(self, n: int) -> None:
+        with self._lock:
+            self.dropped_series += int(n)
 
     # -- registration -------------------------------------------------------
 
@@ -321,13 +389,20 @@ class MetricsRegistry:
     def register_labeled_values(self, plane: str, reporter,
                                 label: str = "session",
                                 labels: Mapping[str, str] | None = None,
-                                gauges: Iterable[str] | None = None) -> None:
+                                gauges: Iterable[str] | None = None,
+                                cap: int | None = None) -> None:
         """Expose a `labeled_values()` reporter ({label value: {key: v}})
         under `handel_<plane>_*` with `label` as a label dimension — the
         session axis of the multi-tenant service. Gauge classification as
-        in register_values."""
+        in register_values. `cap` bounds label cardinality (top-K by
+        activity + `_overflow`); None inherits the registry's series_cap,
+        0 disables."""
         self.register(
-            _LabeledReporterCollector(plane, reporter, label, labels, gauges)
+            _LabeledReporterCollector(
+                plane, reporter, label, labels, gauges,
+                cap=self.series_cap if cap is None else cap,
+                on_drop=self._note_dropped,
+            )
         )
 
     def register_histograms(self, plane: str, reporter,
@@ -403,7 +478,9 @@ class MetricsRegistry:
             ("handel_metrics_scrapes", "counter", float(self.scrapes)),
             ("handel_metrics_scrape_errors", "counter",
              float(self.scrape_errors)),
-            ("handel_metrics_families", "gauge", float(len(merged) + 3)),
+            ("handel_metrics_rollup_dropped_series_ct", "counter",
+             float(self.dropped_series)),
+            ("handel_metrics_families", "gauge", float(len(merged) + 4)),
         ]
         for name, mtype, v in self_fams:
             fam = Family(name, mtype)
@@ -565,6 +642,18 @@ class _Handler(BaseHTTPRequestHandler):
                 payload = src()
             except Exception as e:  # a broken plane must not kill the server
                 self._reply(500, f"alerts snapshot failed: {e}\n".encode())
+                return
+            body = json.dumps(payload).encode() + b"\n"
+            self._reply(200, body, "application/json")
+        elif path == "/fleet":
+            src = reg.fleet_source
+            if src is None:
+                self._reply(501, b"no fleet rollup wired on this node\n")
+                return
+            try:
+                payload = src()
+            except Exception as e:  # a broken rollup must not kill the server
+                self._reply(500, f"fleet snapshot failed: {e}\n".encode())
                 return
             body = json.dumps(payload).encode() + b"\n"
             self._reply(200, body, "application/json")

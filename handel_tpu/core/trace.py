@@ -310,6 +310,20 @@ class LogHistogram:
         self.lo = min(self.lo, other.lo)
         self.hi = max(self.hi, other.hi)
 
+    @classmethod
+    def from_sparse(cls, payload: Mapping) -> "LogHistogram":
+        """Rebuild from one COMPLETE sparse wire form (all buckets + the
+        sum present). The roll-up plane ships absolute sparse maps, so
+        `from_sparse(h.to_sparse())` round-trips exactly."""
+        h = cls()
+        h.merge_sparse(payload)
+        return h
+
+    def copy(self) -> "LogHistogram":
+        h = type(self)()
+        h.merge(self)
+        return h
+
 
 def merge_traces(exports: Iterable[Mapping]) -> dict:
     """Combine per-process Chrome trace exports into one timeline.

@@ -31,6 +31,7 @@ class LifecycleController:
         autotuner=None,
         epoch_manager=None,
         alert_plane=None,
+        host_rollup=None,
         report_source: Callable[[], dict | None] | None = None,
         interval_s: float = 0.25,
         logger: Logger = DEFAULT_LOGGER,
@@ -45,6 +46,10 @@ class LifecycleController:
         # on the same cadence as the actuators it feeds, so an incident's
         # autoscaler nudge lands at most one interval after detection
         self.alert_plane = alert_plane
+        # hierarchical roll-up (obs/rollup.py HostRollup): its local
+        # detectors advance on the control cadence so the digest's top-K
+        # carries live z-scores when the emit interval comes around
+        self.host_rollup = host_rollup
         self.report_source = report_source
         self.interval_s = interval_s
         self.log = logger
@@ -72,6 +77,13 @@ class LifecycleController:
                 except Exception as exc:
                     self.log.warn(
                         "lifecycle", f"alert plane tick failed: {exc!r}"
+                    )
+            if self.host_rollup is not None:
+                try:
+                    self.host_rollup.tick()
+                except Exception as exc:
+                    self.log.warn(
+                        "lifecycle", f"host rollup tick failed: {exc!r}"
                     )
             if self.autoscaler is not None:
                 out["autoscaler"] = await self.autoscaler.tick()
@@ -109,12 +121,14 @@ class LifecycleController:
             out.update(self.epoch_manager.values())
         if self.alert_plane is not None:
             out.update(self.alert_plane.values())
+        if self.host_rollup is not None:
+            out.update(self.host_rollup.values())
         return out
 
     def gauge_keys(self) -> set[str]:
         keys: set[str] = set()
         for part in (self.autoscaler, self.autotuner, self.epoch_manager,
-                     self.alert_plane):
+                     self.alert_plane, self.host_rollup):
             if part is not None:
                 keys |= part.gauge_keys()
         return keys

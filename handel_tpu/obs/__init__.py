@@ -17,6 +17,11 @@ loop:
 - `plane.py`     AlertPlane composes the three, ticks from the
                  LifecycleController, exports `handel_alerts_*` /
                  `handel_incidents_*` metrics and the `/alerts` endpoint
+- `rollup.py`    hierarchical HostRollup/FleetRollup digests so the
+                 fleet-scale plane costs O(hosts), not O(identities):
+                 per-host bounded digests ride the monitor Sink as
+                 chunked deltas, the master merge feeds the same
+                 AlertPlane and exports `handel_fleet_*` + `/fleet`
 """
 
 from handel_tpu.obs.detect import (  # noqa: F401
@@ -30,4 +35,11 @@ from handel_tpu.obs.detect import (  # noqa: F401
 )
 from handel_tpu.obs.incidents import Incident, IncidentLog  # noqa: F401
 from handel_tpu.obs.plane import AlertPlane  # noqa: F401
+from handel_tpu.obs.rollup import (  # noqa: F401
+    FleetRollup,
+    HostRollup,
+    chunk_delta,
+    merge_trace_digests,
+    trace_digest,
+)
 from handel_tpu.obs.slo import BurnRateEvaluator, BurnRule  # noqa: F401

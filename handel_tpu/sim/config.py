@@ -276,6 +276,11 @@ class AlertParams:
     min_hold_s: float = 2.0  # quiet time required before close
     cooldown_s: float = 5.0  # refire inside this reopens, not re-mints
     tick_interval_s: float = 0.25  # evaluation cadence
+    # hierarchical roll-ups (obs/rollup.py): per-host digests -> fleet
+    series_cap: int = 0  # labeled-family cardinality cap (0 = uncapped)
+    rollup_top_k: int = 8  # anomalous series carried per host digest
+    rollup_interval_s: float = 1.0  # host digest emit cadence
+    rollup_stale_s: float = 5.0  # host counts as down after this silence
 
 
 @dataclass
@@ -637,6 +642,10 @@ def load_config(path: str) -> SimConfig:
         min_hold_s=float(al.get("min_hold_s", 2.0)),
         cooldown_s=float(al.get("cooldown_s", 5.0)),
         tick_interval_s=float(al.get("tick_interval_s", 0.25)),
+        series_cap=int(al.get("series_cap", 0)),
+        rollup_top_k=int(al.get("rollup_top_k", 8)),
+        rollup_interval_s=float(al.get("rollup_interval_s", 1.0)),
+        rollup_stale_s=float(al.get("rollup_stale_s", 5.0)),
     )
     if cfg.alerts.fast_window_s >= cfg.alerts.slow_window_s:
         raise ValueError(
@@ -664,6 +673,20 @@ def load_config(path: str) -> SimConfig:
             "alerts needs min_hold_s >= 0 and cooldown_s >= 0, got "
             f"hold {cfg.alerts.min_hold_s} / cooldown "
             f"{cfg.alerts.cooldown_s}"
+        )
+    if cfg.alerts.series_cap < 0:
+        raise ValueError(
+            f"alerts.series_cap must be >= 0, got {cfg.alerts.series_cap}"
+        )
+    if cfg.alerts.rollup_top_k < 1:
+        raise ValueError(
+            f"alerts.rollup_top_k must be >= 1, got {cfg.alerts.rollup_top_k}"
+        )
+    if cfg.alerts.rollup_interval_s <= 0.0 or cfg.alerts.rollup_stale_s <= 0.0:
+        raise ValueError(
+            "alerts needs rollup_interval_s > 0 and rollup_stale_s > 0, got "
+            f"interval {cfg.alerts.rollup_interval_s} / stale "
+            f"{cfg.alerts.rollup_stale_s}"
         )
     sc = raw.get("scenario", {})
     cfg.scenario = ScenarioParams(
@@ -877,6 +900,10 @@ def dump_config(cfg: SimConfig) -> str:
             f"min_hold_s = {al.min_hold_s}",
             f"cooldown_s = {al.cooldown_s}",
             f"tick_interval_s = {al.tick_interval_s}",
+            f"series_cap = {al.series_cap}",
+            f"rollup_top_k = {al.rollup_top_k}",
+            f"rollup_interval_s = {al.rollup_interval_s}",
+            f"rollup_stale_s = {al.rollup_stale_s}",
         ]
     if cfg.scenario.enabled():
         sc = cfg.scenario

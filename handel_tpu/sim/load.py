@@ -182,6 +182,67 @@ class LoadRun:
             self._build_alert_plane() if alert_p is not None
             and alert_p.enabled else None
         )
+        # hierarchical roll-ups (obs/rollup.py): one HostRollup per
+        # region "host", one FleetRollup on this master — the fleet's
+        # hosts-up series joins the SAME alert plane, so a region kill
+        # correlates into the one region-health incident with the lost
+        # host named in the attribution
+        self.host_rollups, self.fleet = self._build_rollups()
+        self._last_rollup_emit = 0.0
+        if self.alerts is not None:
+            self.fleet.attach_alerts(
+                self.alerts,
+                z_threshold=alert_p.z_threshold,
+                ewma_alpha=alert_p.ewma_alpha,
+                min_consecutive=alert_p.min_consecutive,
+            )
+
+    def _build_rollups(self):
+        from handel_tpu.obs.rollup import FleetRollup, HostRollup
+
+        top_k = self.ap.rollup_top_k if self.ap is not None else 8
+        stale = self.ap.rollup_stale_s if self.ap is not None else 5.0
+        hosts: dict[str, HostRollup] = {}
+        for name, region in self.fed.by_name.items():
+            hr = HostRollup(name, top_k=top_k)
+
+            def region_fold(region=region):
+                return [(region.stats(), self.fed.labeled_gauge_keys())]
+
+            hr.attach_fold("region", region_fold)
+
+            def session_fold(region=region):
+                m = region.cluster.manager
+                return ((vals, m.labeled_gauge_keys())
+                        for vals in m.labeled_values().values())
+
+            hr.attach_fold("sessions", session_fold)
+
+            def device_fold(region=region):
+                plane = region.cluster.service.plane
+                return ((vals, plane.labeled_gauge_keys())
+                        for vals in plane.labeled_values().values())
+
+            hr.attach_fold("device", device_fold)
+            hr.watch(
+                f"{name}-queue-depth",
+                lambda region=region: float(
+                    region.cluster.service.queue_depth()
+                ),
+            )
+            hosts[name] = hr
+        return hosts, FleetRollup(top_k=top_k, stale_after_s=stale)
+
+    def _rollup_emit(self, now: float) -> None:
+        """Per-region digest deltas -> chunked wire form -> the fleet.
+        A killed region stops emitting (its process would be gone), so
+        the fleet marks it lost and the hosts-up series pages."""
+        self._last_rollup_emit = now
+        for name, hr in self.host_rollups.items():
+            if self.fed.by_name[name].killed:
+                self.fleet.mark_lost(name)
+                continue
+            hr.emit(self.fleet.ingest)
 
     # -- the alert plane ----------------------------------------------------
 
@@ -309,6 +370,11 @@ class LoadRun:
     async def _alert_loop(self) -> None:
         while True:
             await asyncio.sleep(self.ap.tick_interval_s)
+            now = time.monotonic()
+            for hr in self.host_rollups.values():
+                hr.tick(now)
+            if now - self._last_rollup_emit >= self.ap.rollup_interval_s:
+                self._rollup_emit(now)
             self.alerts.tick()
 
     # -- arrival path -------------------------------------------------------
@@ -589,6 +655,28 @@ class LoadRun:
         }
         return block, latency_ms, round(fp_rate, 4)
 
+    def _fleet_block(self, wall_s: float) -> dict:
+        """The hierarchical roll-up summary: each region is one host, the
+        master's FleetRollup merged their digests over the run. Series
+        count is O(key-union across hosts) — the flatness of
+        `series_total` across load sweeps is the O(hosts) contract."""
+        series = self.fleet.series_count()  # merges -> fresh lastMergeMs
+        fv = self.fleet.values()
+        bytes_total = fv["ingestBytesCt"]
+        hosts = max(1, len(self.host_rollups))
+        return {
+            "hosts": sorted(self.host_rollups),
+            "hosts_up": self.fleet.hosts_up(),
+            "lost_hosts": self.fleet.lost_hosts(),
+            "series_total": series,
+            "ingests": fv["ingestsCt"],
+            "ingest_bytes": bytes_total,
+            "rollup_bytes_per_host_s": round(
+                bytes_total / hosts / max(wall_s, 1e-9), 1
+            ),
+            "fleet_eval_ms": fv["lastMergeMs"],
+        }
+
     def _report(self, wall_s: float) -> dict:
         lp, fp = self.lp, self.fp
         fd = self.fed.front_door
@@ -669,6 +757,7 @@ class LoadRun:
                     for name, vals in self.fed.labeled_values().items()
                 },
             },
+            "fleet": self._fleet_block(wall_s),
         }
         # shared invariant specs (sim/report_checks.py): the same
         # predicates load_smoke re-asserts stamp `checks` + `ok`
@@ -689,13 +778,18 @@ async def run_load(load_p, fed_p, workdir: str,
     if metrics_port is not None:
         from handel_tpu.core.metrics import MetricsRegistry, MetricsServer
 
-        reg = MetricsRegistry()
+        reg = MetricsRegistry(
+            series_cap=alert_p.series_cap if alert_p is not None else 0,
+        )
         reg.register_values("federation", run.fed)
         reg.register_labeled_values(
             "federation", run.fed, label="region",
             gauges=run.fed.labeled_gauge_keys(),
         )
         reg.register_values("load", run)
+        # handel_fleet_* families + the /fleet JSON endpoint, fed by the
+        # per-region HostRollup digests the alert loop emits
+        run.fleet.register_metrics(reg)
         if run.alerts is not None:
             run.alerts.register_metrics(reg)
         reg.add_readiness("federation_up", lambda: True)

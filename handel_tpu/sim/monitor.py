@@ -65,6 +65,11 @@ class Sink:
             for payload in _chunk_hist(name, key, h):
                 self._send(payload)
 
+    def record_rollup(self, rollup) -> int:
+        """Emit a HostRollup's changed-keys delta over this sink (each
+        chunk already fits the datagram budget). Returns wire bytes."""
+        return rollup.emit(self._send)
+
     def _send(self, payload: dict) -> None:
         try:
             self._sock.sendto(json.dumps(payload).encode(), self.addr)
@@ -221,10 +226,21 @@ class _SinkProto(asyncio.DatagramProtocol):
     def datagram_received(self, data: bytes, addr) -> None:
         try:
             msg = json.loads(data.decode())
+        except ValueError:
+            return
+        if isinstance(msg, dict) and "rollup" in msg:
+            fleet = self.mon.fleet
+            if fleet is not None:
+                try:
+                    fleet.ingest(msg)
+                except (ValueError, TypeError, AttributeError):
+                    pass  # malformed digest chunk: drop, never kill
+            return
+        try:
             name = str(msg["name"])
             values = msg.get("values", {})
             hists = msg.get("hists", {})
-        except (ValueError, KeyError, AttributeError):
+        except (ValueError, KeyError, AttributeError, TypeError):
             return
         try:
             for k, v in values.items():
@@ -243,9 +259,13 @@ class Monitor:
         port: int,
         data_filter: "DataFilter | None" = None,
         expected_keys: Sequence[str] = (),
+        fleet=None,
     ):
         self.port = port
         self.stats = Stats(data_filter=data_filter, expected=expected_keys)
+        #: optional obs.rollup.FleetRollup — `{"rollup": ...}` datagrams
+        #: are host-digest chunks routed here instead of Stats columns
+        self.fleet = fleet
         self._transport = None
 
     async def start(self) -> None:

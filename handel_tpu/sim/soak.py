@@ -143,12 +143,14 @@ class SoakRun:
             self._build_alert_plane()
             if alert_p is not None and alert_p.enabled else None
         )
+        self.host_rollup = self._build_host_rollup()
         self.controller = LifecycleController(
             self.cluster.service,
             autoscaler=self.autoscaler,
             autotuner=self.autotuner,
             epoch_manager=self.epochs,
             alert_plane=self.alerts,
+            host_rollup=self.host_rollup,
             report_source=self._stage_report,
             interval_s=p.control_interval_s,
             logger=logger,
@@ -216,6 +218,46 @@ class SoakRun:
 
         plane.incidents.add_listener(on_incident)
         return plane
+
+    def _build_host_rollup(self):
+        """This process's hierarchical digest (obs/rollup.py): the
+        per-session and per-lane surfaces fold to the key union, so the
+        soak report (and any master this host reports to) carries one
+        bounded block however many sessions the spawner churns through.
+        Ticked by the LifecycleController on the control cadence."""
+        from handel_tpu.obs.rollup import HostRollup
+
+        top_k = self.ap.rollup_top_k if self.ap is not None else 8
+        hr = HostRollup("soak0", top_k=top_k)
+        m = self.cluster.manager
+        svc = self.cluster.service
+        hr.attach_reporter("service", svc)
+        hr.attach_fold("sessions", lambda: (
+            (vals, m.labeled_gauge_keys())
+            for vals in m.labeled_values().values()
+        ))
+        hr.attach_fold("device", lambda: (
+            (vals, svc.plane.labeled_gauge_keys())
+            for vals in svc.plane.labeled_values().values()
+        ))
+        hr.set_trace(lambda: self.rec.export()["traceEvents"])
+        hr.watch("rollup-queue-depth", lambda: float(svc.queue_depth()))
+        hr.watch("rollup-sessions-live", lambda: float(m.live_count()))
+        return hr
+
+    def _rollup_block(self) -> dict:
+        """Nested rollup block: digest bounds + the wire budget a chunked
+        delta emission costs at report time."""
+        d = self.host_rollup.digest()
+        nbytes = self.host_rollup.emit()
+        return {
+            "host": d["host"],
+            "surfaces": d["surfaces"],
+            "series": sum(len(d[s]) for s in ("counters", "gauges",
+                                              "hists")),
+            "delta_bytes": nbytes,
+            "top_anomalous": d["anoms"],
+        }
 
     def _alert_block(self) -> dict | None:
         """Nested alerts block: the drill's detection latency (first
@@ -400,6 +442,7 @@ class SoakRun:
                 "summary": summary,
                 "lifecycle": self.controller.values(),
                 "alerts": self._alert_block(),
+                "rollup": self._rollup_block(),
             },
         }
         # the shared invariant specs (sim/report_checks.py) stamp `checks`

@@ -185,6 +185,21 @@ def aggregate(scrapes: list[dict]) -> dict:
             if rid is not None:
                 regions.setdefault(rid, {})[field] = v
 
+    # hierarchical roll-up plane (obs/rollup.py FleetRollup
+    # .register_metrics): one row per host digest from the `host` label
+    # dimension, beside the master's O(hosts) merge aggregates
+    fleet_hosts: dict[str, dict] = {}
+    for field, name in (
+        ("up", "handel_fleet_host_up"),
+        ("seq", "handel_fleet_digest_seq"),
+        ("series", "handel_fleet_series_ct"),
+        ("top_z", "handel_fleet_top_z"),
+    ):
+        for labels, v in _samples(fams, name):
+            hid = labels.get("host")
+            if hid is not None:
+                fleet_hosts.setdefault(hid, {})[field] = v
+
     # alert/incident plane (handel_tpu/obs/ via AlertPlane
     # .register_metrics): one row per burn rule from the `rule` label
     # dimension, beside the detector-bank and incident-log aggregates
@@ -287,6 +302,17 @@ def aggregate(scrapes: list[dict]) -> dict:
         "load_p50": first("handel_load_open_loop_p50_s"),
         "load_p99": first("handel_load_open_loop_p99_s"),
         "load_goodput": first("handel_load_goodput"),
+        # hierarchical roll-up plane (obs/rollup.py): per-host digest rows
+        # plus the master FleetRollup's merge aggregates — the watch
+        # surface stays O(hosts) no matter how many identities run
+        "fleet_hosts": fleet_hosts,
+        "fleet_hosts_total": first("handel_fleet_hosts_total"),
+        "fleet_hosts_up": first("handel_fleet_hosts_up"),
+        "fleet_hosts_down": first("handel_fleet_hosts_down"),
+        "fleet_series_total": first("handel_fleet_series_total"),
+        "fleet_ingests": total("handel_fleet_ingests_ct"),
+        "fleet_ingest_bytes": total("handel_fleet_ingest_bytes_ct"),
+        "fleet_merge_ms": first("handel_fleet_last_merge_ms"),
         # alert/incident plane (handel_tpu/obs/): burn-rule rows plus the
         # incident-lifecycle counters — the `sim watch` alerting surface
         "alert_rules": alert_rules,
@@ -455,6 +481,50 @@ def render_federation(model: dict) -> list[str]:
     return lines
 
 
+def render_fleet(model: dict) -> list[str]:
+    """Hierarchical roll-up block (obs/rollup.py): the master
+    FleetRollup's O(hosts) view — hosts up/down, merged series count,
+    wire ingest volume, one row per host digest, and the top anomalous
+    host by its detectors' strongest z-score. The burn state beside it
+    comes from the same AlertPlane the roll-ups feed (render_alerts)."""
+    hosts = model.get("fleet_hosts") or {}
+    if not hosts and model.get("fleet_hosts_total") is None:
+        return []
+    top = None
+    for hid, row in hosts.items():
+        z = row.get("top_z")
+        if z is not None and (top is None or abs(z) > abs(top[1])):
+            top = (hid, z)
+    mm = model.get("fleet_merge_ms")
+    head = (
+        f"fleet    hosts {_num(model.get('fleet_hosts_up'))}/"
+        f"{_num(model.get('fleet_hosts_total'))} up"
+        f" ({_num(model.get('fleet_hosts_down'))} down)  "
+        f"series {_num(model.get('fleet_series_total'))}  "
+        f"ingests {_num(model.get('fleet_ingests'))} "
+        f"({_num(model.get('fleet_ingest_bytes'))} B)  "
+        f"merge {('--' if mm is None else f'{mm:.2f}ms')}"
+    )
+    if model.get("alerts_page") is not None:
+        burn = "PAGE" if model["alerts_page"] else (
+            "warn" if model.get("alerts_warn") else "ok"
+        )
+        head += f"  burn {burn}"
+    lines = [head]
+    if top is not None:
+        lines.append(f"  top anomalous host {top[0]}  z {top[1]:+.2f}")
+    for hid in sorted(hosts):
+        row = hosts[hid]
+        up = "up" if row.get("up", 0.0) >= 1.0 else "DOWN"
+        lines.append(
+            f"  {hid:>10} {up:<4}"
+            f"  seq {int(row.get('seq', 0)):>5}"
+            f"  series {int(row.get('series', 0)):>4}"
+            f"  top-z {row.get('top_z', 0.0):+.2f}"
+        )
+    return lines
+
+
 #: handel_alerts_alert_state code -> display name (obs/slo.py STATE_CODE)
 _ALERT_STATE_NAMES = {0.0: "ok", 1.0: "WARN", 2.0: "PAGE"}
 
@@ -532,6 +602,10 @@ def render(model: dict, endpoints: list[str], up: int, tick: int) -> str:
     if frows:
         lines.append("")
         lines.extend(frows)
+    flrows = render_fleet(model)
+    if flrows:
+        lines.append("")
+        lines.extend(flrows)
     arows = render_alerts(model)
     if arows:
         lines.append("")

@@ -79,6 +79,7 @@ class SwarmHost:
         proc_index: int = 0,
         trace: bool = False,
         trace_capacity: int = 1 << 16,
+        rollup_top_k: int = 8,
     ):
         self.total = total
         self.lo, self.hi = lo, hi
@@ -122,6 +123,45 @@ class SwarmHost:
         self._completed = 0
         self._wall_s = 0.0
         self._scan_handle = None
+        self.host_rollup = self._build_host_rollup(rollup_top_k)
+
+    def _build_host_rollup(self, top_k: int):
+        """O(key-union) digest over this block's O(N) vnode surfaces
+        (obs/rollup.py): the master sees one bounded digest per process,
+        never a reporter row per identity. The local DetectorBank rides
+        the _scan cadence so the digest's top-K carries real z-scores."""
+        from handel_tpu.obs.detect import counter_rate
+        from handel_tpu.obs.rollup import HostRollup
+
+        hr = HostRollup(f"proc{self.proc_index}", top_k=top_k)
+
+        def vnode_fold():
+            gk = (
+                frozenset(self.vnodes[0].handel.gauge_keys())
+                if self.vnodes else frozenset()
+            )
+            return ((v.handel.values(), gk) for v in self.vnodes)
+
+        hr.attach_fold("swarm", vnode_fold)
+        hr.attach_reporter("router", self.router)
+        hr.attach_reporter("wheel", self.wheel)
+        hr.attach_reporter("pager", self.pager)
+        hr.attach_fold("service", lambda: [({
+            "launchesCt": float(self.service.launches),
+            "candidatesCt": float(self.service.candidates),
+            "dedupHitsCt": float(self.service.cache.hits),
+            "completedSize": float(self._completed),
+        }, frozenset({"completedSize"}))])
+        if self.recorder is not None:
+            hr.set_trace(lambda: self.recorder.export()["traceEvents"])
+        hr.watch("swarm-completed", lambda: float(self._completed))
+        hr.watch("swarm-udp-rate", counter_rate(
+            lambda: self.router.values().get("swarmUdpSent")
+        ))
+        hr.watch("swarm-launch-rate", counter_rate(
+            lambda: float(self.service.launches)
+        ))
+        return hr
 
     # -- build / lifecycle -------------------------------------------------
 
@@ -197,6 +237,7 @@ class SwarmHost:
                 v.done_ts = now
                 done += 1
         self._completed = done
+        self.host_rollup.tick()
         if done == len(self.vnodes):
             self._all_done.set()
 
@@ -338,7 +379,7 @@ def merge_summaries(parts: list[dict]) -> dict:
 
 def host_from_params(
     p, lo: int, hi: int, *, block: int, ports, proc_index: int,
-    trace: bool, trace_capacity: int,
+    trace: bool, trace_capacity: int, rollup_top_k: int = 8,
 ) -> SwarmHost:
     """Build one SwarmHost from a SwarmParams section (sim/config.py)."""
     host = SwarmHost(
@@ -359,8 +400,49 @@ def host_from_params(
         proc_index=proc_index,
         trace=trace,
         trace_capacity=trace_capacity,
+        rollup_top_k=rollup_top_k,
     )
     return host
+
+
+def _merge_host_digests(cfg, workdir: str, parts: list[dict]) -> dict:
+    """Master-side FleetRollup over the per-process host digests: the
+    O(hosts) summary keys plus fleet_rollup.json for `sim watch` / CI.
+    Missing digest files degrade to an empty block, never a failure."""
+    from handel_tpu.obs.rollup import FleetRollup
+
+    al = getattr(cfg, "alerts", None)
+    fleet = FleetRollup(
+        top_k=al.rollup_top_k if al is not None else 8,
+        stale_after_s=al.rollup_stale_s if al is not None else 5.0,
+    )
+    hosts = 0
+    for i in range(len(parts)):
+        path = os.path.join(workdir, f"host_digest_{i}.json")
+        if not os.path.exists(path):
+            continue
+        with open(path) as f:
+            fleet.ingest_digest(json.load(f))
+        hosts += 1
+    if not hosts:
+        return {}
+    merged = fleet.merged()
+    wall = max((float(p.get("wall_s", 0.0)) for p in parts), default=0.0)
+    bytes_per_host = sum(
+        float(p.get("rollup_bytes", 0.0)) for p in parts
+    ) / hosts
+    out = {
+        "fleet_hosts": hosts,
+        "fleet_series_count": merged["series"],
+        "rollup_bytes_per_host_s": round(
+            bytes_per_host / wall if wall else 0.0, 1
+        ),
+        "fleet_eval_ms": round(fleet.last_merge_ms, 3),
+    }
+    with open(os.path.join(workdir, "fleet_rollup.json"), "w") as f:
+        json.dump({**out, "fleet": fleet.fleet_payload()}, f, indent=1)
+        f.write("\n")
+    return out
 
 
 async def run_swarm(cfg, workdir: str, config_path: str = "") -> dict:
@@ -381,15 +463,22 @@ async def run_swarm(cfg, workdir: str, config_path: str = "") -> dict:
         bounds.append((lo, lo + share))
         lo += share
 
+    al = getattr(cfg, "alerts", None)
+    rollup_top_k = al.rollup_top_k if al is not None else 8
     trace_paths: list[str] = []
     if procs_n == 1:
         host = host_from_params(
             p, 0, p.identities, block=block, ports=[], proc_index=0,
             trace=cfg.trace, trace_capacity=cfg.trace_capacity,
+            rollup_top_k=rollup_top_k,
         )
         part = await host.run(timeout)
         with open(os.path.join(workdir, "swarm_rollup_0.json"), "w") as f:
             json.dump(host.rollup(), f)
+        digest = host.host_rollup.digest()
+        part["rollup_bytes"] = host.host_rollup.emit()
+        with open(os.path.join(workdir, "host_digest_0.json"), "w") as f:
+            json.dump(digest, f)
         if host.recorder is not None:
             trace_paths.append(
                 host.recorder.dump(
@@ -462,6 +551,7 @@ async def run_swarm(cfg, workdir: str, config_path: str = "") -> dict:
 
     summary = merge_summaries(parts)
     summary["per_process"] = parts
+    summary.update(_merge_host_digests(cfg, workdir, parts))
     if trace_paths:
         # streamed critical-path + level-wave report over the per-process
         # trace files (sim/trace_cli.py; never loads all files at once)

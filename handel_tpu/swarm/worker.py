@@ -40,6 +40,7 @@ async def run_worker(args) -> int:
         proc_index=args.index,
         trace=cfg.trace,
         trace_capacity=cfg.trace_capacity,
+        rollup_top_k=cfg.alerts.rollup_top_k,
     )
     await host.router.open(ports["swarm"][args.index])
     host.build()
@@ -65,6 +66,15 @@ async def run_worker(args) -> int:
         os.path.join(args.workdir, f"swarm_rollup_{args.index}.json"), "w"
     ) as f:
         json.dump(host.rollup(), f)
+    # hierarchical roll-up: the bounded host digest the master's
+    # FleetRollup merges (obs/rollup.py) + the wire bytes a live chunked
+    # delta emission would have cost — O(key-union), never O(identities)
+    digest = host.host_rollup.digest()
+    summary["rollup_bytes"] = host.host_rollup.emit()
+    with open(
+        os.path.join(args.workdir, f"host_digest_{args.index}.json"), "w"
+    ) as f:
+        json.dump(digest, f)
     if host.recorder is not None:
         host.recorder.dump(
             os.path.join(args.workdir, f"swarm_trace_{args.index}.json")

@@ -114,6 +114,13 @@ SIDE_METRICS = {
     # drill (clean control runs must hold this at exactly 0.0)
     "detection_latency_ms": "lower",
     "false_positive_rate": "lower",
+    # hierarchical roll-up plane (obs/rollup.py / bench.py rollup_bench /
+    # scripts/rollup_smoke.py): master-side merged series count (must
+    # stay O(hosts) — flat across identity sweeps), delta wire bytes per
+    # host per emission interval, and the master's merge wall
+    "fleet_series_count": "lower",
+    "rollup_bytes_per_host_s": "lower",
+    "fleet_eval_ms": "lower",
 }
 
 # Metrics that exist once per Field backend. Their comparison key grows a

@@ -1,0 +1,498 @@
+"""Hierarchical roll-up tests (ISSUE 20): LogHistogram merge
+order-invariance, two-level (host -> master) merge == flat merge
+bit-for-bit including the chunked sparse-wire path, digest boundedness at
+4,096 vnodes, delta idempotence under UDP redelivery, the
+AlertPlane-from-rollups host-kill drill (exactly one incident with host
+attribution), the cardinality cap with its explicit `_overflow` row, the
+/fleet endpoint, the `sim watch` fleet block, and the [alerts] config
+round trip for the new roll-up knobs."""
+
+from __future__ import annotations
+
+import json
+import random
+import urllib.error
+import urllib.request
+
+import pytest
+
+from handel_tpu.core.metrics import (
+    MetricsRegistry,
+    MetricsServer,
+    parse_exposition,
+)
+from handel_tpu.core.trace import LogHistogram
+from handel_tpu.obs import AlertPlane, BurnRule
+from handel_tpu.obs.rollup import (
+    MAX_DATAGRAM,
+    FleetRollup,
+    HostRollup,
+    chunk_delta,
+    merge_trace_digests,
+    trace_digest,
+)
+
+
+def _exact(rng: random.Random) -> float:
+    """Values on the 1/1024 grid are exactly representable, so float sums
+    are associative and bit-for-bit equality across merge orders holds."""
+    return rng.randrange(1, 1 << 20) / 1024.0
+
+
+# -- satellite 2: merge order-invariance + two-level == flat ------------------
+
+
+def test_loghistogram_merge_order_invariant():
+    rng = random.Random(11)
+    parts = []
+    for _ in range(8):
+        h = LogHistogram()
+        for _ in range(rng.randrange(1, 200)):
+            h.add(_exact(rng))
+        parts.append(h)
+    merges = []
+    for seed in range(6):
+        order = list(range(len(parts)))
+        random.Random(seed).shuffle(order)
+        m = LogHistogram()
+        for i in order:
+            m.merge(parts[i])
+        merges.append(m)
+    ref = merges[0].to_sparse()
+    for m in merges[1:]:
+        assert m.to_sparse() == ref  # bit-for-bit, not approx
+
+
+def test_loghistogram_from_sparse_roundtrip():
+    h = LogHistogram()
+    rng = random.Random(3)
+    for _ in range(100):
+        h.add(_exact(rng))
+    h2 = LogHistogram.from_sparse(h.to_sparse())
+    assert h2.to_sparse() == h.to_sparse()
+    assert h.copy().to_sparse() == h.to_sparse()
+
+
+def _mk_surfaces(rng: random.Random, n: int):
+    """n vnode-like surfaces sharing one key union (exact values)."""
+    out = []
+    for _ in range(n):
+        out.append((
+            {"msgSentCt": _exact(rng), "verifiedCt": _exact(rng),
+             "levelRate": _exact(rng)},
+            {"levelRate"},
+        ))
+    return out
+
+
+def _mk_host(name: str, surfaces, hist_values) -> HostRollup:
+    hr = HostRollup(name, clock=lambda: 0.0)
+    hr.attach_fold("swarm", lambda: list(surfaces))
+
+    class _Rep:
+        def values(self):
+            return {"launchesCt": sum(v[0]["msgSentCt"] for v in surfaces)}
+
+        def gauge_keys(self):
+            return set()
+
+        def histograms(self):
+            h = LogHistogram()
+            for v in hist_values:
+                h.add(v)
+            return {"verifyLatencyS": h}
+
+    hr.attach_reporter("device", _Rep())
+    return hr
+
+
+def test_two_level_merge_equals_flat():
+    rng = random.Random(42)
+    per_host = [_mk_surfaces(rng, 16) for _ in range(4)]
+    per_hist = [[_exact(rng) for _ in range(50)] for _ in range(4)]
+
+    # two-level: one HostRollup per host -> FleetRollup
+    fleet = FleetRollup(clock=lambda: 0.0)
+    for i in range(4):
+        hr = _mk_host(f"h{i}", per_host[i], per_hist[i])
+        fleet.ingest_digest(hr.digest())
+    two = fleet.merged()
+
+    # flat: every surface folded into ONE HostRollup
+    flat_surfaces = [s for hs in per_host for s in hs]
+    flat = HostRollup("flat", clock=lambda: 0.0)
+    flat.attach_fold("swarm", lambda: list(flat_surfaces))
+    fd = flat.digest()
+
+    assert two["counters"]["swarm.msgSentCt"] == fd["counters"][
+        "swarm.msgSentCt"]
+    assert two["counters"]["swarm.verifiedCt"] == fd["counters"][
+        "swarm.verifiedCt"]
+    assert two["gauges"]["swarm.levelRate"] == fd["gauges"][
+        "swarm.levelRate"]
+    # the merged histogram equals a flat merge of the host histograms
+    ref = LogHistogram()
+    for vals in per_hist:
+        for v in vals:
+            ref.add(v)
+    assert two["hists"]["device.verifyLatencyS"].to_sparse() == (
+        ref.to_sparse())
+
+
+def test_two_level_merge_order_invariant_over_wire():
+    """Chunked emission, shuffled + duplicated delivery, any host order:
+    the master state is identical to the direct full-digest path."""
+    rng = random.Random(7)
+    hosts = [
+        _mk_host(f"h{i}", _mk_surfaces(rng, 8),
+                 [_exact(rng) for _ in range(400)])
+        for i in range(3)
+    ]
+    ref = FleetRollup(clock=lambda: 0.0)
+    chunk_sets = []
+    for hr in hosts:
+        ref.ingest_digest(hr.digest())
+        chunk_sets.append(chunk_delta(hr.delta()))
+    for seed in range(4):
+        srng = random.Random(seed)
+        chunks = [c for cs in chunk_sets for c in cs]
+        chunks = chunks + srng.sample(chunks, len(chunks) // 2)  # redeliver
+        srng.shuffle(chunks)
+        fleet = FleetRollup(clock=lambda: 0.0)
+        for c in chunks:
+            fleet.ingest(json.loads(json.dumps(c)))  # through the wire form
+        a, b = fleet.merged(), ref.merged()
+        assert a["counters"] == b["counters"]
+        assert a["gauges"] == b["gauges"]
+        assert {k: h.to_sparse() for k, h in a["hists"].items()} == {
+            k: h.to_sparse() for k, h in b["hists"].items()}
+
+
+def test_sink_chunk_hist_wire_path_reassembles_exactly():
+    """The existing sparse-wire chunked path (Sink._chunk_hist): summing
+    bucket chunks master-side reassembles the histogram bit-for-bit."""
+    from handel_tpu.sim.monitor import _chunk_hist
+
+    h = LogHistogram()
+    rng = random.Random(5)
+    for _ in range(20000):
+        h.add(_exact(rng))
+    merged = LogHistogram()
+    n_chunks = 0
+    for payload in _chunk_hist("node0", "verifyLatencyS", h):
+        assert len(json.dumps(payload).encode()) <= MAX_DATAGRAM
+        merged.merge_sparse(payload["hists"]["verifyLatencyS"])
+        n_chunks += 1
+    assert n_chunks >= 1
+    assert merged.to_sparse() == h.to_sparse()
+
+
+# -- satellite 4: digest bounds, idempotence, the drill -----------------------
+
+
+def test_digest_bounded_at_4096_vnodes():
+    """Series count depends on the key union, never the vnode count, and
+    every wire chunk respects the UDP budget."""
+    counts = {}
+    for n in (64, 4096):
+        rng = random.Random(9)
+        hr = HostRollup(f"host-{n}", clock=lambda: 0.0)
+        surfaces = _mk_surfaces(rng, n)
+        hr.attach_fold("swarm", lambda: list(surfaces))
+        counts[n] = hr.series_count()
+        for payload in chunk_delta(hr.delta()):
+            assert len(json.dumps(payload).encode()) <= MAX_DATAGRAM
+        d = hr.digest()
+        assert d["surfaces"] == n
+    assert counts[64] == counts[4096] == 3  # O(key-union), not O(vnodes)
+
+
+def test_delta_redelivery_is_idempotent():
+    state = {"v": 0.0}
+    hr = HostRollup("h0", clock=lambda: 0.0)
+    hr.attach_fold("svc", lambda: [
+        ({"workCt": state["v"], "depth": state["v"] / 2.0}, {"depth"})])
+    once = FleetRollup(clock=lambda: 0.0)
+    twice = FleetRollup(clock=lambda: 0.0)
+    for step in range(5):
+        state["v"] += 16.0
+        chunks = chunk_delta(hr.delta())
+        for c in chunks:
+            once.ingest(c)
+        dup = chunks * 2
+        random.Random(step).shuffle(dup)
+        for c in dup:
+            twice.ingest(c)
+    a, b = once.merged(), twice.merged()
+    assert a["counters"] == b["counters"]
+    assert a["gauges"] == b["gauges"]
+    assert twice.stale_drops == 0  # same-seq redelivery is not "stale"
+
+
+def test_stale_seq_dropped_and_heartbeat_on_quiet_delta():
+    state = {"v": 1.0}
+    hr = HostRollup("h0", clock=lambda: 0.0)
+    hr.attach_fold("svc", lambda: [({"workCt": state["v"]}, set())])
+    fleet = FleetRollup(clock=lambda: 0.0)
+    first = chunk_delta(hr.delta())
+    for c in first:
+        fleet.ingest(c, now=1.0)
+    state["v"] = 2.0
+    for c in chunk_delta(hr.delta()):
+        fleet.ingest(c, now=2.0)
+    assert fleet.merged()["counters"]["svc.workCt"] == 2.0
+    # the stale seq-1 chunk arrives late: dropped, no value regression
+    assert fleet.ingest(first[0], now=3.0) is False
+    assert fleet.stale_drops == 1
+    assert fleet.merged()["counters"]["svc.workCt"] == 2.0
+    # an unchanged digest still emits one heartbeat chunk for liveness
+    quiet = chunk_delta(hr.delta())
+    assert len(quiet) == 1
+    assert set(quiet[0]["rollup"]) == {"host", "seq"}
+    assert fleet.ingest(quiet[0], now=4.0) is True
+    assert fleet.lost_hosts(now=4.1) == []
+
+
+def test_alert_plane_fed_exclusively_from_rollups_host_kill_drill():
+    """The region-kill contract, reproduced purely from roll-ups: one
+    lost host -> exactly one incident whose attribution names it, held
+    open while lost, closed on recovery."""
+    from handel_tpu.sim.config import AlertParams
+
+    t = {"now": 0.0}
+    ap = AlertParams(window_scale=0.01, min_hold_s=0.5, cooldown_s=2.0)
+    plane = AlertPlane.from_params(ap, clock=lambda: t["now"])
+    fleet = FleetRollup(top_k=4, stale_after_s=0.5, clock=lambda: t["now"])
+    counts = {f"h{i}": 0.0 for i in range(4)}
+    hosts = {}
+    for name in counts:
+        hr = HostRollup(name, clock=lambda: t["now"])
+        hr.attach_fold(
+            "svc",
+            lambda name=name: [({"goodCt": counts[name], "badCt": 0.0},
+                                set())],
+        )
+        hosts[name] = hr
+    fleet.attach_alerts(
+        plane,
+        burn_rules=[(BurnRule("fleet-goodput", budget=0.05),
+                     "svc.goodCt", "svc.badCt")],
+    )
+
+    def step(emit=frozenset(counts)):
+        for name in counts:
+            counts[name] += 5.0
+        for name in emit:
+            hosts[name].emit(fleet.ingest)
+        plane.tick()
+        t["now"] += 0.05
+
+    while t["now"] < 2.0:  # healthy baseline: all four hosts report
+        step()
+    assert plane.incidents.opened == 0
+    assert fleet.hosts_up() == 4
+
+    kill_t = t["now"]
+    live = frozenset(n for n in counts if n != "h2")
+    opened_at = None
+    while t["now"] < kill_t + 2.0:  # h2 goes dark -> staleness marks it
+        step(emit=live)
+        if plane.incidents.current is not None and opened_at is None:
+            opened_at = t["now"]
+    assert opened_at is not None
+    assert opened_at - kill_t <= 1.0  # stale_after_s + a few ticks
+    inc = plane.incidents.current
+    assert inc.attribution["lost_hosts"] == ["h2"]
+    assert inc.attribution["fleet"]["hosts_up"] == 3
+    assert fleet.hosts_up() == 3
+
+    recover_t = t["now"]
+    while t["now"] < recover_t + 2.0:  # h2 reports again
+        step()
+    assert fleet.hosts_up() == 4
+    assert plane.incidents.current is None
+    assert plane.incidents.opened == 1  # exactly one incident, now closed
+    assert inc.state == "closed"
+
+
+def test_trace_digest_bounded_and_merge_keeps_slowest_chain():
+    events = []
+    for i in range(5000):  # 5000 spans, 3 stages
+        stage = ("verify", "pack", "gossip")[i % 3]
+        events.append({"ph": "X", "name": stage, "ts": float(i * 10),
+                       "dur": 8.0, "pid": 0, "tid": 0})
+    d = trace_digest(events)
+    assert d["spans"] == 5000
+    assert set(d["stages_ms"]) == {"verify", "pack", "gossip"}
+    assert len(d["chain_tail"]) <= 8  # bounded, never the raw ring
+    slow = dict(d, wall_ms=d["wall_ms"] * 3)
+    m = merge_trace_digests([("fast", d), ("slow", slow)])
+    assert m["slowest_host"] == "slow"
+    assert m["spans"] == 10000
+    assert m["stages_ms"]["verify"] == pytest.approx(
+        d["stages_ms"]["verify"] * 2)
+
+
+# -- satellite 1: cardinality governance --------------------------------------
+
+
+class _ManyRows:
+    def __init__(self, n: int):
+        self.n = n
+
+    def labeled_values(self):
+        return {f"s{i:03d}": {"workCt": float(i + 1), "depth": 2.0}
+                for i in range(self.n)}
+
+    def labeled_gauge_keys(self):
+        return {"depth"}
+
+
+def test_labeled_series_cap_overflow_row_preserves_mass():
+    reg = MetricsRegistry(series_cap=4)
+    reg.register_labeled_values("svc", _ManyRows(10), label="session",
+                                gauges={"depth"})
+    fams = parse_exposition(reg.exposition())
+    rows = {l["session"]: v for l, v in
+            fams["handel_svc_work_ct"]["samples"]}
+    assert "_overflow" in rows  # never silently truncated
+    assert len(rows) == 5  # top-4 by activity + the overflow row
+    assert sum(rows.values()) == sum(range(1, 11))  # counter mass intact
+    # the activity ranking keeps the hottest rows as distinct series
+    assert {"s009", "s008", "s007", "s006"} <= set(rows)
+    assert reg.dropped_series == 6
+    drop = fams["handel_metrics_rollup_dropped_series_ct"]["samples"]
+    assert drop[0][1] == 6.0
+
+
+def test_series_cap_zero_is_uncapped():
+    reg = MetricsRegistry()
+    reg.register_labeled_values("svc", _ManyRows(10), label="session",
+                                gauges={"depth"})
+    fams = parse_exposition(reg.exposition())
+    assert len(fams["handel_svc_work_ct"]["samples"]) == 10
+    assert reg.dropped_series == 0
+
+
+# -- /fleet endpoint + handel_fleet_* families --------------------------------
+
+
+def _small_fleet() -> FleetRollup:
+    fleet = FleetRollup(top_k=4, clock=lambda: 0.0)
+    for name in ("hostA", "hostB"):
+        hr = HostRollup(name, clock=lambda: 0.0)
+        hr.attach_fold("svc", lambda: [
+            ({"launchesCt": 5.0, "queueDepth": 2.0}, {"queueDepth"})])
+        hr.tick()
+        hr.emit(fleet.ingest)
+    return fleet
+
+
+def test_fleet_metrics_families_and_endpoint():
+    fleet = _small_fleet()
+    fleet.mark_lost("hostB")
+    reg = MetricsRegistry()
+    fleet.register_metrics(reg)
+    fams = parse_exposition(reg.exposition())
+    for name in ("handel_fleet_hosts_total", "handel_fleet_hosts_up",
+                 "handel_fleet_series_total", "handel_fleet_ingests_ct",
+                 "handel_fleet_host_up", "handel_fleet_digest_seq"):
+        assert name in fams, sorted(fams)
+    rows = {l["host"]: v for l, v in
+            fams["handel_fleet_host_up"]["samples"]}
+    assert rows == {"hostA": 1.0, "hostB": 0.0}
+    assert fams["handel_fleet_hosts_up"]["type"] == "gauge"
+    assert fams["handel_fleet_ingests_ct"]["type"] == "counter"
+
+    srv = MetricsServer(reg, port=0).start()
+    try:
+        with urllib.request.urlopen(
+            f"http://{srv.address}/fleet", timeout=3
+        ) as r:
+            payload = json.loads(r.read())
+        assert payload["hosts_up"] == 1
+        assert payload["lost_hosts"] == ["hostB"]
+        assert payload["hosts"]["hostA"]["up"] is True
+        assert payload["series_total"] == 2
+    finally:
+        srv.stop()
+
+
+def test_fleet_endpoint_unwired_is_501():
+    srv = MetricsServer(MetricsRegistry(), port=0).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"http://{srv.address}/fleet", timeout=3)
+        assert ei.value.code == 501
+    finally:
+        srv.stop()
+
+
+# -- satellite 3: the `sim watch` fleet block ---------------------------------
+
+
+def test_watch_fleet_row():
+    from handel_tpu.sim import watch_cli
+
+    fleet = _small_fleet()
+    fleet.mark_lost("hostB")
+    reg = MetricsRegistry()
+    fleet.register_metrics(reg)
+    fams = parse_exposition(reg.exposition())
+    model = watch_cli.aggregate([fams])
+    assert model["fleet_hosts_up"] == 1.0
+    assert model["fleet_hosts_total"] == 2.0
+    assert set(model["fleet_hosts"]) == {"hostA", "hostB"}
+    frame = watch_cli.render(model, ["127.0.0.1:1"], up=1, tick=1)
+    assert "fleet    hosts 1/2 up (1 down)" in frame
+    assert "series 2" in frame
+    assert "hostB DOWN" in frame
+    assert "hostA up" in frame
+    assert "top anomalous host" in frame
+
+
+# -- wire-budget contract + [alerts] roll-up knobs ----------------------------
+
+
+def test_rollup_budget_matches_monitor_sink():
+    from handel_tpu.sim import monitor
+
+    assert MAX_DATAGRAM == monitor.MAX_DATAGRAM
+
+
+def test_rollup_config_round_trip(tmp_path):
+    from handel_tpu.sim.config import (
+        AlertParams,
+        SimConfig,
+        dump_config,
+        load_config,
+    )
+
+    cfg = SimConfig()
+    assert cfg.alerts == AlertParams()
+    cfg.alerts.series_cap = 512
+    cfg.alerts.rollup_top_k = 4
+    cfg.alerts.rollup_interval_s = 0.5
+    cfg.alerts.rollup_stale_s = 2.5
+    path = tmp_path / "rollup.toml"
+    path.write_text(dump_config(cfg))
+    loaded = load_config(str(path))
+    assert loaded.alerts.series_cap == 512
+    assert loaded.alerts.rollup_top_k == 4
+    assert loaded.alerts.rollup_interval_s == 0.5
+    assert loaded.alerts.rollup_stale_s == 2.5
+
+
+def test_rollup_config_validation(tmp_path):
+    from handel_tpu.sim.config import load_config
+
+    for body in (
+        "[alerts]\nseries_cap = -1\n",
+        "[alerts]\nrollup_top_k = 0\n",
+        "[alerts]\nrollup_interval_s = 0.0\n",
+        "[alerts]\nrollup_stale_s = -2.0\n",
+    ):
+        bad = tmp_path / "bad.toml"
+        bad.write_text(body)
+        with pytest.raises(ValueError):
+            load_config(str(bad))
